@@ -1,0 +1,36 @@
+"""edl_tpu — a TPU-native elastic deep-learning training framework.
+
+A ground-up re-design of the capabilities of wopeizl/edl (an elastic-scheduling
+system for distributed DL jobs on Kubernetes, built around PaddlePaddle parameter
+servers) for TPU hardware and the JAX/XLA stack:
+
+- The parameter-server data plane (C++ `paddle pserver`, sparse port pools, gRPC
+  gradient servers) is replaced by SPMD training under ``jax.jit`` over a
+  ``jax.sharding.Mesh`` — gradients ride ICI all-reduces inserted by XLA, and
+  large embedding tables are sharded across the mesh instead of living in a
+  separate pserver process (reference: docker/paddle_k8s:3-12,
+  pkg/jobparser.go:232-247).
+- The fault-tolerant master + etcd sidecar (reference: pkg/jobparser.go:167-227,
+  /usr/bin/master in docker/paddle_k8s:26-32) becomes a single native C++
+  coordinator service (`native/coordinator`) providing membership epochs, rank
+  assignment, a leased data-shard task queue, barriers and a small KV store.
+- "Parallelism++" elasticity (reference: pkg/autoscaler.go:361-362 rewriting
+  TrainerJob.Spec.Parallelism) becomes checkpoint-restore mesh rescale: on a
+  membership epoch change workers checkpoint asynchronously, re-initialize the
+  mesh at the new world size, restore, and resume from the task queue.
+- The cluster autoscaler (reference: pkg/autoscaler.go) keeps its pure
+  fixed-point dry-run core but scores TPU slice quota instead of nvidia.com/gpu.
+
+Package layout:
+  api/         TrainingJob spec types, defaults, validation   (ref: pkg/resource, pkg/apis)
+  controller/  controller, per-job updater, autoscaler, cluster (ref: pkg/*.go, pkg/updater)
+  coordinator/ Python client + in-process server for the C++ coordinator (ref: master+etcd)
+  runtime/     elastic trainer runtime: mesh, train loop, data leases, checkpoints
+  parallel/    sharding helpers: dp/tp/sp mesh axes, sharded embeddings
+  ops/         Pallas TPU kernels for hot ops
+  models/      fit_a_line, MNIST, word2vec, CTR deep-wide (flagship), ResNet
+  launcher/    pod/process role launcher + discovery           (ref: docker/paddle_k8s, k8s_tools.py)
+  tools/       collector metrics harness                       (ref: example/fit_a_line/collector.py)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,81 @@
+"""Defaulting + validation for TrainingJob specs.
+
+Keeps the reference's defaulting/validation semantics
+(`pkg/updater/jobparser.go:40-64`, `pkg/jobparser.go:47-71`): fill default
+port/image/passes, force ``fault_tolerant`` when the job is elastic, reject
+inverted instance ranges — plus TPU-specific checks (power-of-two-ish slice
+shapes, mesh-axis product must divide the chip count).
+"""
+
+from __future__ import annotations
+
+from edl_tpu.api.types import TrainingJob
+
+DEFAULT_PORT = 7164
+DEFAULT_PASSES = 1
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def set_defaults(job: TrainingJob) -> TrainingJob:
+    """Fill reference-style defaults in place and return the job."""
+    spec = job.spec
+    if spec.port <= 0:
+        spec.port = DEFAULT_PORT
+    if spec.passes <= 0:
+        spec.passes = DEFAULT_PASSES
+    if not spec.trainer.image:
+        spec.trainer.image = spec.image
+    if not spec.coordinator.image:
+        spec.coordinator.image = spec.image
+    # Elastic implies fault tolerant (ref: pkg/jobparser.go:56-58) — a job whose
+    # trainer count changes mid-flight must tolerate member churn.
+    if job.elastic():
+        spec.fault_tolerant = True
+    spec.coordinator.min_instance = spec.coordinator.max_instance = 1
+    if not spec.parallelism:
+        spec.parallelism = {"data": max(1, spec.tpu.chips_per_trainer)}
+    return job
+
+
+def validate(job: TrainingJob) -> TrainingJob:
+    """Raise ValidationError on a malformed spec; return the job otherwise."""
+    spec = job.spec
+    if not job.name:
+        raise ValidationError("job name is required")
+    t = spec.trainer
+    if t.min_instance < 1:
+        raise ValidationError(f"trainer.min_instance must be >= 1, got {t.min_instance}")
+    if t.max_instance < t.min_instance:
+        raise ValidationError(
+            f"trainer.max_instance ({t.max_instance}) < min_instance ({t.min_instance})"
+        )
+    if spec.tpu.chips_per_trainer < 0:
+        raise ValidationError("tpu.chips_per_trainer must be >= 0")
+    if spec.port <= 0 or spec.port > 65535:
+        raise ValidationError(f"invalid port {spec.port}")
+    if spec.passes < 1:
+        raise ValidationError(f"passes must be >= 1, got {spec.passes}")
+    if job.elastic() and not spec.fault_tolerant:
+        raise ValidationError("elastic jobs must be fault_tolerant (run set_defaults first)")
+    # Parallelism sizes are per-trainer-slice local factors (the data axis
+    # additionally spans trainers), so their product must divide the slice.
+    local_chips = max(1, spec.tpu.chips_per_trainer)
+    axis_product = 1
+    for axis, size in spec.parallelism.items():
+        if size < 1:
+            raise ValidationError(f"parallelism axis {axis!r} must be >= 1, got {size}")
+        axis_product *= size
+    if local_chips % axis_product != 0:
+        raise ValidationError(
+            f"parallelism axes product {axis_product} must divide "
+            f"chips_per_trainer {local_chips}"
+        )
+    return job
+
+
+def normalize(job: TrainingJob) -> TrainingJob:
+    """set_defaults + validate, the controller's admission path."""
+    return validate(set_defaults(job))

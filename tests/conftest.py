@@ -1,0 +1,19 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's testing insight (SURVEY §4): multi-"node" behavior is
+tested hermetically on one host — the reference used fake clientsets
+(`pkg/client/.../fake`); we use fake cluster providers plus a virtual 8-device
+CPU platform so every sharding/collective path compiles and runs without TPUs.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

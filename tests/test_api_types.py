@@ -1,0 +1,115 @@
+"""Tests for the job-spec type system.
+
+Models the reference's black-box resource tests
+(`pkg/resource/training_job_test.go:27-46` — NeedGPU/Elastic predicates) and
+quantity tests (`pkg/utils_test.go:25-48`).
+"""
+
+import pytest
+
+from edl_tpu.api import (
+    JobPhase,
+    ResourceList,
+    TrainingJob,
+    ValidationError,
+    parse_quantity,
+    set_defaults,
+    validate,
+)
+from edl_tpu.api.validation import normalize
+
+EXAMPLE_YAML = """
+metadata:
+  name: example
+  namespace: default
+spec:
+  image: "edl-tpu/job:latest"
+  port: 7164
+  fault_tolerant: true
+  passes: 2
+  tpu:
+    accelerator_type: v5e
+    chips_per_trainer: 4
+  trainer:
+    entrypoint: "python train.py"
+    workspace: "/workspace"
+    min_instance: 2
+    max_instance: 10
+    resources:
+      requests: {cpu: "500m", memory: "600Mi"}
+      limits: {cpu: "1", memory: "1Gi"}
+  coordinator:
+    resources:
+      requests: {cpu: "100m", memory: "256Mi"}
+"""
+
+
+def test_parse_quantity():
+    assert parse_quantity("500m") == 0.5
+    assert parse_quantity("1") == 1.0
+    assert parse_quantity("30Gi") == 30 * 1024**3
+    assert parse_quantity("2k") == 2000.0
+    assert parse_quantity(4) == 4.0
+    with pytest.raises(ValueError):
+        parse_quantity("abc")
+
+
+def test_resource_list_math():
+    a = ResourceList.make({"cpu": "1", "memory": "1Gi"})
+    b = ResourceList.make({"cpu": "500m", "memory": "1Gi", "tpu": 4})
+    a.add(b)
+    assert a["cpu"] == 1.5
+    assert a["memory"] == 2 * 1024**3
+    assert a["tpu"] == 4.0
+    assert b.fits_within({"cpu": 1.0, "memory": 2**31, "tpu": 8.0})
+    assert not b.fits_within({"cpu": 0.25, "memory": 2**31, "tpu": 8.0})
+
+
+def test_from_yaml_and_predicates():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    assert job.name == "example"
+    assert job.spec.trainer.min_instance == 2
+    assert job.spec.trainer.max_instance == 10
+    assert job.elastic()
+    assert job.need_tpu()
+    req = job.trainer_request()
+    assert req["tpu"] == 4.0
+    assert req["cpu"] == 0.5
+
+
+def test_not_elastic_when_range_collapsed():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    job.spec.trainer.max_instance = job.spec.trainer.min_instance
+    assert not job.elastic()
+
+
+def test_defaults_force_fault_tolerant_for_elastic():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    job.spec.fault_tolerant = False
+    set_defaults(job)
+    assert job.spec.fault_tolerant  # elastic => fault tolerant
+    assert job.spec.trainer.image == "edl-tpu/job:latest"
+    assert job.spec.parallelism == {"data": 4}
+
+
+def test_validate_rejects_bad_ranges():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    job.spec.trainer.min_instance = 5
+    job.spec.trainer.max_instance = 2
+    with pytest.raises(ValidationError):
+        validate(job)
+
+
+def test_validate_rejects_incompatible_mesh():
+    job = TrainingJob.from_yaml(EXAMPLE_YAML)
+    set_defaults(job)
+    job.spec.parallelism = {"data": 3}  # 3 does not divide 4 chips
+    with pytest.raises(ValidationError):
+        validate(job)
+
+
+def test_normalize_roundtrip():
+    job = normalize(TrainingJob.from_yaml(EXAMPLE_YAML))
+    again = TrainingJob.from_dict(job.to_dict())
+    assert again.spec.to_dict() == job.spec.to_dict()
+    assert job.status.phase == JobPhase.NONE
