@@ -24,7 +24,7 @@ from edl_tpu.api.types import (
     TrainingJobSpec,
     TrainingJobStatus,
 )
-from edl_tpu.api.validation import ValidationError, set_defaults, validate
+from edl_tpu.api.validation import ValidationError, normalize, set_defaults, validate
 
 __all__ = [
     "JobPhase",
@@ -40,6 +40,7 @@ __all__ = [
     "TrainingJobStatus",
     "ValidationError",
     "format_quantity",
+    "normalize",
     "parse_quantity",
     "set_defaults",
     "validate",

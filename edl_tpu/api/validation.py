@@ -62,13 +62,15 @@ def validate(job: TrainingJob) -> TrainingJob:
         raise ValidationError("elastic jobs must be fault_tolerant (run set_defaults first)")
     # Parallelism sizes are per-trainer-slice local factors (the data axis
     # additionally spans trainers), so their product must divide the slice.
-    local_chips = max(1, spec.tpu.chips_per_trainer)
+    # CPU-only jobs (chips_per_trainer == 0) map axes onto virtual host
+    # devices instead, with no divisibility constraint to enforce here.
     axis_product = 1
     for axis, size in spec.parallelism.items():
         if size < 1:
             raise ValidationError(f"parallelism axis {axis!r} must be >= 1, got {size}")
         axis_product *= size
-    if local_chips % axis_product != 0:
+    local_chips = spec.tpu.chips_per_trainer
+    if local_chips > 0 and local_chips % axis_product != 0:
         raise ValidationError(
             f"parallelism axes product {axis_product} must divide "
             f"chips_per_trainer {local_chips}"

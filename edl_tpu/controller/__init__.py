@@ -1,0 +1,35 @@
+"""Control plane: cluster accounting, autoscaler, controller, per-job updater.
+
+TPU-native re-design of the reference Go control plane (`pkg/controller.go`,
+`pkg/autoscaler.go`, `pkg/cluster.go`, `pkg/updater/`): same split — a pure,
+exhaustively-testable scheduling core; thin I/O edges behind a provider
+interface; one actor goroutine-equivalent (thread) per job.
+"""
+
+from edl_tpu.controller.cluster import ClusterProvider, ClusterResource, FakeCluster, NodeInfo, PodInfo
+from edl_tpu.controller.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    JobState,
+    fulfillment,
+    make_room_dry_run,
+    scale_all_dry_run,
+    scale_dry_run,
+    sorted_jobs_by_fulfillment,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "ClusterProvider",
+    "ClusterResource",
+    "FakeCluster",
+    "JobState",
+    "NodeInfo",
+    "PodInfo",
+    "fulfillment",
+    "make_room_dry_run",
+    "scale_all_dry_run",
+    "scale_dry_run",
+    "sorted_jobs_by_fulfillment",
+]

@@ -1,0 +1,405 @@
+"""Elastic autoscaler: pure fixed-point dry-run core + event loop.
+
+Re-design of the reference autoscaler (`pkg/autoscaler.go:34-511`) with TPU
+slice quota as the scarce resource. The structure is kept deliberately
+identical in spirit because it is the reference's best idea:
+
+- a **pure** single-step decision function ``scale_dry_run`` that mutates only
+  a passed-in ClusterResource snapshot (ref: `pkg/autoscaler.go:201-291`),
+- an iterative **fixed point** ``scale_all_dry_run`` that scales the
+  most-starved job up first and the least-starved down first until nothing
+  changes (ref: `pkg/autoscaler.go:296-337`),
+- a thin actuation loop that writes the resulting parallelism targets through
+  the ClusterProvider with retries (ref: `pkg/autoscaler.go:339-376`),
+- a 5 s tick + event channel main loop (ref: `pkg/autoscaler.go:451-485`).
+
+TPU-specific decisions (SURVEY §7 hard part 3):
+- The scheduling granule is ``chips_per_trainer`` on a single host; scale-up
+  requires a node-fit search over per-node idle chips, not just global totals.
+- ``max_load_desired`` caps CPU load as in the reference; TPU chips are
+  never oversubscribed (they are integer granules, there is no "load").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from edl_tpu.api.quantity import ResourceList
+from edl_tpu.api.types import ScaleRecord, TrainingJob
+from edl_tpu.controller.cluster import ClusterProvider, ClusterResource
+
+log = logging.getLogger("edl_tpu.autoscaler")
+
+
+@dataclass
+class JobState:
+    """Autoscaler-side view of one job (ref: `job` wrapper, autoscaler.go:34-64)."""
+
+    job: TrainingJob
+    #: current trainer replica count as last actuated/observed.
+    current: int = 0
+
+    @property
+    def name(self) -> str:
+        return self.job.name
+
+    def min_instance(self) -> int:
+        return self.job.spec.trainer.min_instance
+
+    def max_instance(self) -> int:
+        return self.job.spec.trainer.max_instance
+
+    def request(self) -> ResourceList:
+        return self.job.trainer_request()
+
+    def limit(self) -> ResourceList:
+        return self.job.trainer_limit()
+
+
+def fulfillment(state: JobState, additional: int = 0) -> float:
+    """Scale-range satisfaction in [0,1] (ref: Fulfillment, autoscaler.go:54-64).
+
+    1.0 when at max_instance, 0.0 when at min_instance; jobs at their floor are
+    the most starved and scale up first.
+    """
+    lo, hi = state.min_instance(), state.max_instance()
+    cur = state.current + additional
+    if hi == lo:
+        return 1.0
+    return max(0.0, min(1.0, (cur - lo) / float(hi - lo)))
+
+
+def sorted_jobs_by_fulfillment(
+    states: Iterable[JobState], diff: Dict[str, int] | None = None
+) -> List[JobState]:
+    """Ascending fulfillment with resource-hunger tiebreaks
+    (ref: sortedJobs + Less, autoscaler.go:97-129,175-189): ties broken by
+    TPU-chips request desc, then CPU desc, then memory desc — the hungrier job
+    goes first so the big granules get placed while fragmentation is lowest.
+    """
+    diff = diff or {}
+
+    def key(s: JobState) -> Tuple:
+        r = s.request()
+        return (
+            fulfillment(s, diff.get(s.name, 0)),
+            -r.get_q("tpu"),
+            -r.get_q("cpu"),
+            -r.get_q("memory"),
+            s.name,
+        )
+
+    return sorted(states, key=key)
+
+
+def scale_dry_run(
+    resource: ClusterResource,
+    state: JobState,
+    additional: int,
+    max_load_desired: float,
+    scale_down: bool,
+) -> int:
+    """Single-step scale decision for one job (ref: scaleDryRun, autoscaler.go:201-291).
+
+    Returns -1, 0 or +1 and accounts the change into ``resource`` so the
+    fixed-point iteration sees the consequences of its own decisions. Pure:
+    touches nothing but its arguments.
+    """
+    plus = 0
+    request = state.request()
+    cur = state.current + additional
+
+    def commit(delta: int) -> int:
+        if delta > 0:
+            node = resource.search_assignable_node(request)
+            if node is None:
+                return 0
+            resource.assign(node, request)
+        elif delta < 0:
+            resource.release_any(request)
+        return delta
+
+    cpu_req = request.get_q("cpu")
+    tpu_req = request.get_q("tpu")
+    mem_req = request.get_q("memory")
+
+    if scale_down:
+        # Scale-down triggers when CPU demand exceeds the load ceiling, or TPU
+        # demand exceeds physical chips (ref: autoscaler.go:230-249). TPU has
+        # no oversubscription, so only an over-committed queue trips it.
+        cpu_over = resource.total.get_q("cpu") > 0 and (
+            resource.requested.get_q("cpu")
+            > max_load_desired * resource.total.get_q("cpu")
+        )
+        tpu_over = resource.requested.get_q("tpu") > resource.total.get_q("tpu")
+        if (cpu_over or tpu_over) and cur > state.min_instance():
+            return commit(-1)
+        return 0
+
+    # -- scale up --------------------------------------------------------------
+    if cur >= state.max_instance():  # cap (ref: :252-257)
+        return 0
+    if mem_req > 0 and resource.free("memory") < mem_req:  # memory feasibility (:259-263)
+        return 0
+    if cpu_req > 0 and (
+        resource.requested.get_q("cpu") + cpu_req
+        > max_load_desired * resource.total.get_q("cpu")
+    ):  # CPU headroom vs ceiling (:271-273)
+        return 0
+    if tpu_req > 0 and resource.free("tpu") < tpu_req:  # chip availability (:275-288)
+        return 0
+    plus = commit(1)  # node-fit search inside commit (:264-267)
+    return plus
+
+
+def scale_all_dry_run(
+    resource: ClusterResource,
+    states: List[JobState],
+    max_load_desired: float,
+) -> Dict[str, int]:
+    """Iterate single-step decisions to a fixed point
+    (ref: scaleAllJobsDryRun, autoscaler.go:296-337).
+
+    Each round: scale UP starting from the most-starved job, then scale DOWN
+    starting from the least-starved, until a full round changes nothing. This
+    converges: scale-up never pushes demand past the ceiling, and scale-down
+    only fires while demand is over it, so the two arms cannot ping-pong.
+    """
+    diff: Dict[str, int] = {s.name: 0 for s in states}
+    r = resource.copy()
+    changed = True
+    guard = 0
+    while changed and guard < 1000:
+        changed = False
+        guard += 1
+        for s in sorted_jobs_by_fulfillment(states, diff):
+            d = scale_dry_run(r, s, diff[s.name], max_load_desired, scale_down=False)
+            if d:
+                diff[s.name] += d
+                changed = True
+        for s in reversed(sorted_jobs_by_fulfillment(states, diff)):
+            d = scale_dry_run(r, s, diff[s.name], max_load_desired, scale_down=True)
+            if d:
+                diff[s.name] += d
+                changed = True
+    return dict(diff)
+
+
+def make_room_dry_run(
+    resource: ClusterResource,
+    states: List[JobState],
+    pending_requests: List[ResourceList],
+) -> Dict[str, int]:
+    """Shrink running elastic jobs so pending pods can be placed
+    (ref: findPendingJob + reschedulable set, autoscaler.go:406-422,487-511;
+    narrative doc/boss_tutorial.md:289-301).
+
+    Greedily place each pending pod against per-node idle resources (their
+    requests are already counted in ``resource.requested`` by inquire, so
+    placement consumes node_idle only); while any remain unplaceable, shrink
+    the least-starved job that is above its floor by one and retry. No
+    scale-up arm runs in this mode, so the plan cannot oscillate. Terminates:
+    every iteration either places a pod or shrinks a replica, both finite.
+    """
+    diff: Dict[str, int] = {s.name: 0 for s in states}
+    r = resource.copy()
+    remaining = [req.copy() for req in pending_requests]
+    while remaining:
+        placed_any = True
+        while placed_any:
+            placed_any = False
+            for req in list(remaining):
+                node = r.search_assignable_node(req)
+                if node is not None:
+                    r.node_idle[node].sub(req)
+                    remaining.remove(req)
+                    placed_any = True
+        if not remaining:
+            break
+        shrinkable = [
+            s
+            for s in reversed(sorted_jobs_by_fulfillment(states, diff))
+            if s.current + diff[s.name] > s.min_instance()
+        ]
+        if not shrinkable:
+            break  # floors reached; remaining pods stay pending
+        victim = shrinkable[0]
+        r.release_any(victim.request())
+        diff[victim.name] -= 1
+    return dict(diff)
+
+
+# ---------------------------------------------------------------------------
+# Event loop
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class AutoscalerConfig:
+    #: control-loop period (ref: defaultLoopDur 5 s, autoscaler.go:30-32).
+    loop_seconds: float = 5.0
+    #: CPU load ceiling (ref: cmd/edl/edl.go:19 default 0.97, deployed 0.9).
+    max_load_desired: float = 0.97
+    #: actuation retries (ref: retry x5, autoscaler.go:346-370).
+    update_retries: int = 5
+
+
+@dataclass
+class _Event:
+    kind: str  # "add" | "update" | "del"
+    job: TrainingJob
+
+
+class Autoscaler:
+    """Event-driven scaling loop (ref: Autoscaler, autoscaler.go:66-95,451-485).
+
+    Jobs arrive via on_add/on_update/on_del (informer callbacks in the
+    reference, controller callbacks here); a single loop thread owns all state
+    — the actor pattern the reference used to avoid locking its job map.
+    """
+
+    def __init__(self, cluster: ClusterProvider, config: AutoscalerConfig | None = None):
+        self.cluster = cluster
+        self.config = config or AutoscalerConfig()
+        self.jobs: Dict[str, JobState] = {}
+        self._events: "queue.Queue[_Event]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: most recent plan, for observability/collector (job -> target).
+        self.last_plan: Dict[str, int] = {}
+
+    # -- informer-style callbacks (ref: autoscaler.go:158-171) -----------------
+
+    def on_add(self, job: TrainingJob) -> None:
+        self._events.put(_Event("add", job))
+
+    def on_update(self, job: TrainingJob) -> None:
+        self._events.put(_Event("update", job))
+
+    def on_del(self, job: TrainingJob) -> None:
+        self._events.put(_Event("del", job))
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.run_forever, name="edl-autoscaler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def run_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                evt = self._events.get(timeout=self.config.loop_seconds)
+                self._apply_event(evt)
+                # Drain any queued events before a scaling pass.
+                while True:
+                    try:
+                        self._apply_event(self._events.get_nowait())
+                    except queue.Empty:
+                        break
+            except queue.Empty:
+                pass
+            try:
+                self.step()
+            except Exception:  # keep the loop alive like the reference's logged errors
+                log.exception("autoscaler step failed")
+
+    # -- one scaling pass (ref: autoscaler.go:461-485) -------------------------
+
+    def step(self) -> Dict[str, int]:
+        elastic = [s for s in self.jobs.values() if s.job.elastic()]
+        if not elastic:
+            return {}
+        for s in elastic:
+            s.current = self.cluster.get_trainer_parallelism(s.name)
+        snapshot = self.cluster.inquire()
+        pending = self._pending_jobs()
+        if pending:
+            # Make-room mode: shrink running jobs so pending pods can place;
+            # pending jobs themselves are never shrink victims.
+            pending_reqs = [
+                p.requests
+                for name in pending
+                for p in self.cluster.job_pods(name, "trainer")
+                if p.phase == "Pending"
+            ]
+            shrink_states = [s for s in elastic if s.name not in pending]
+            diff = make_room_dry_run(snapshot, shrink_states, pending_reqs)
+            reason = "make-room"
+        else:
+            diff = scale_all_dry_run(snapshot, elastic, self.config.max_load_desired)
+            reason = "autoscale"
+        target = {
+            s.name: s.current + diff.get(s.name, 0)
+            for s in elastic
+            if diff.get(s.name, 0) != 0
+        }
+        self.last_plan = dict(target)
+        if target:
+            log.info("scaling plan: %s (%s)", target, reason)
+        self._actuate(target, reason)
+        return target
+
+    def _pending_jobs(self) -> List[str]:
+        """Jobs whose trainer pods are all pending — they need room made
+        (ref: findPendingJob, autoscaler.go:406-422)."""
+        out = []
+        for s in self.jobs.values():
+            pods = self.cluster.job_pods(s.name, "trainer")
+            if pods and all(p.phase == "Pending" for p in pods):
+                out.append(s.name)
+        return out
+
+    def _actuate(self, target: Dict[str, int], reason: str = "autoscale") -> None:
+        """Write parallelism targets with retries (ref: autoscaler.go:339-376).
+
+        Unknown jobs (deleted between plan and actuation) are dropped without
+        retrying; only transient provider errors are retried.
+        """
+        for name, parallelism in target.items():
+            state = self.jobs.get(name)
+            for attempt in range(self.config.update_retries):
+                try:
+                    before = self.cluster.get_trainer_parallelism(name)
+                    self.cluster.set_trainer_parallelism(name, parallelism)
+                    if state is not None:
+                        state.current = parallelism
+                        state.job.status.parallelism = parallelism
+                        state.job.status.scale_history.append(
+                            ScaleRecord(
+                                timestamp=time.time(),
+                                from_replicas=before,
+                                to_replicas=parallelism,
+                                reason=reason,
+                            )
+                        )
+                    break
+                except KeyError:
+                    log.info("job %s vanished before actuation; dropping", name)
+                    break
+                except Exception:
+                    if attempt == self.config.update_retries - 1:
+                        log.exception("failed to scale %s after retries", name)
+                    else:
+                        time.sleep(0.05)
+
+    def _apply_event(self, evt: _Event) -> None:
+        if evt.kind in ("add", "update"):
+            st = self.jobs.get(evt.job.name)
+            if st is None:
+                cur = evt.job.status.parallelism or evt.job.spec.trainer.min_instance
+                self.jobs[evt.job.name] = JobState(job=evt.job, current=cur)
+            else:
+                st.job = evt.job
+        elif evt.kind == "del":
+            self.jobs.pop(evt.job.name, None)

@@ -1,0 +1,206 @@
+"""Autoscaler core tests.
+
+Mirrors the reference's pure-logic table tests
+(`pkg/autoscaler_internal_test.go:96-438`): scale up/down under CPU/TPU/memory
+pressure, fixed-point convergence, fulfillment math, sort order — all against
+hand-built ClusterResource fixtures, no cluster.
+"""
+
+from edl_tpu.api import ResourceList, TrainingJob
+from edl_tpu.api.validation import normalize
+from edl_tpu.controller import (
+    Autoscaler,
+    AutoscalerConfig,
+    FakeCluster,
+    JobState,
+    NodeInfo,
+    fulfillment,
+    scale_all_dry_run,
+    scale_dry_run,
+    sorted_jobs_by_fulfillment,
+)
+from edl_tpu.controller.cluster import inquire_resource
+
+
+def make_job(name, min_i=2, max_i=10, chips=4, cpu="1", mem="1Gi", cur=2):
+    """Job factory (ref: makeJob, autoscaler_internal_test.go:56-94)."""
+    job = TrainingJob.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {
+                "tpu": {"chips_per_trainer": chips},
+                "trainer": {
+                    "min_instance": min_i,
+                    "max_instance": max_i,
+                    "resources": {
+                        "requests": {"cpu": cpu, "memory": mem},
+                        "limits": {"cpu": cpu, "memory": mem},
+                    },
+                },
+            },
+        }
+    )
+    return JobState(job=normalize(job), current=cur)
+
+
+def tpu_cluster(n_hosts=4, chips_per_host=4, cpu=16, mem_gi=64):
+    """A v5e-pod-like fixture: n hosts x chips."""
+    return [
+        NodeInfo(
+            name=f"host{i}",
+            allocatable=ResourceList.make(
+                {"cpu": cpu, "memory": f"{mem_gi}Gi", "tpu": chips_per_host}
+            ),
+        )
+        for i in range(n_hosts)
+    ]
+
+
+def snapshot(nodes, pods=()):
+    return inquire_resource(list(nodes), list(pods))
+
+
+def test_fulfillment_math():
+    # ref: autoscaler_internal_test.go:366-375
+    assert fulfillment(make_job("a", min_i=2, max_i=10, cur=2)) == 0.0
+    assert fulfillment(make_job("a", min_i=2, max_i=10, cur=10)) == 1.0
+    assert fulfillment(make_job("a", min_i=2, max_i=6, cur=4)) == 0.5
+    assert fulfillment(make_job("a", min_i=3, max_i=3, cur=3)) == 1.0
+
+
+def test_sort_order_starved_first_with_hunger_tiebreak():
+    # ref: autoscaler_internal_test.go:377-438
+    starved = make_job("starved", min_i=2, max_i=10, cur=2)
+    happy = make_job("happy", min_i=2, max_i=10, cur=10)
+    mid_small = make_job("mid-small", min_i=2, max_i=6, cur=4, chips=4)
+    mid_big = make_job("mid-big", min_i=2, max_i=6, cur=4, chips=8)
+    order = [s.name for s in sorted_jobs_by_fulfillment([happy, mid_small, mid_big, starved])]
+    assert order == ["starved", "mid-big", "mid-small", "happy"]
+
+
+def test_scale_up_when_chips_free():
+    r = snapshot(tpu_cluster(n_hosts=4))
+    s = make_job("j", cur=2)
+    # 2 trainers already placed -> account them
+    r.assign("host0", s.request())
+    r.assign("host1", s.request())
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=False) == 1
+    assert r.requested["tpu"] == 12.0
+
+
+def test_scale_up_blocked_by_chip_exhaustion():
+    r = snapshot(tpu_cluster(n_hosts=2))  # 8 chips total
+    s = make_job("j", cur=2)
+    r.assign("host0", s.request())
+    r.assign("host1", s.request())  # all 8 chips used
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=False) == 0
+
+
+def test_scale_up_blocked_by_fragmentation():
+    """6 chips free cluster-wide but only 2 per host: a 4-chip granule must NOT fit."""
+    nodes = tpu_cluster(n_hosts=3, chips_per_host=4)
+    r = snapshot(nodes)
+    for h in ("host0", "host1", "host2"):
+        r.assign(h, ResourceList.make({"tpu": 2}))  # fragment every host
+    s = make_job("j", chips=4, cur=0)
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=False) == 0
+
+
+def test_scale_up_blocked_by_cpu_ceiling():
+    # ref: CPU headroom vs maxLoadDesired, autoscaler.go:271-273
+    nodes = tpu_cluster(n_hosts=1, chips_per_host=16, cpu=10)
+    r = snapshot(nodes)
+    s = make_job("j", cpu="4", cur=0)
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=False) == 1
+    assert scale_dry_run(r, s, 1, 0.97, scale_down=False) == 1
+    # third trainer would need 12 > 0.97*10 CPUs
+    assert scale_dry_run(r, s, 2, 0.97, scale_down=False) == 0
+
+
+def test_scale_up_blocked_by_memory():
+    nodes = tpu_cluster(n_hosts=1, chips_per_host=16, mem_gi=2)
+    r = snapshot(nodes)
+    s = make_job("j", mem="3Gi", cur=0)
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=False) == 0
+
+
+def test_scale_down_on_overcommit():
+    # ref: scale-down when demand exceeds ceiling, autoscaler.go:230-249
+    nodes = tpu_cluster(n_hosts=1, chips_per_host=8)
+    r = snapshot(nodes)
+    s = make_job("j", cur=3)  # 12 chips requested > 8 available
+    r.requested.add(ResourceList.make({"tpu": 12, "cpu": 3, "memory": "3Gi"}))
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=True) == -1
+    assert r.requested["tpu"] == 8.0
+
+
+def test_scale_down_respects_min_instance():
+    nodes = tpu_cluster(n_hosts=1, chips_per_host=4)
+    r = snapshot(nodes)
+    s = make_job("j", min_i=2, cur=2)
+    r.requested.add(ResourceList.make({"tpu": 8}))  # overcommitted
+    assert scale_dry_run(r, s, 0, 0.97, scale_down=True) == 0
+
+
+def test_fixed_point_fills_cluster():
+    # ref: scaleAllJobsDryRun, autoscaler_internal_test.go:256-364
+    r = snapshot(tpu_cluster(n_hosts=4, chips_per_host=4))  # 16 chips
+    a = make_job("a", min_i=1, max_i=10, cur=1)
+    b = make_job("b", min_i=1, max_i=10, cur=1)
+    r.assign("host0", a.request())
+    r.assign("host1", b.request())
+    diff = scale_all_dry_run(r, [a, b], 0.97)
+    # 2 placed + 2 more possible (16 chips / 4 per trainer = 4 trainers)
+    assert diff["a"] + diff["b"] == 2
+    assert abs(diff["a"] - diff["b"]) <= 1  # fair split
+
+
+def test_fixed_point_favors_starved_job():
+    r = snapshot(tpu_cluster(n_hosts=4, chips_per_host=4))
+    rich = make_job("rich", min_i=1, max_i=4, cur=3)
+    poor = make_job("poor", min_i=1, max_i=4, cur=1)
+    for h in ("host0", "host1", "host2"):
+        r.assign(h, rich.request())
+    r.assign("host3", poor.request())
+    diff = scale_all_dry_run(r, [rich, poor], 0.97)
+    assert diff == {"rich": 0, "poor": 0} or diff["poor"] >= diff["rich"]
+
+
+def test_autoscaler_end_to_end_with_fake_cluster():
+    """Full loop against the fake provider: job grows to fill free chips."""
+    cluster = FakeCluster(tpu_cluster(n_hosts=4, chips_per_host=4))
+    job = make_job("grow", min_i=1, max_i=10, cur=1).job
+    req = job.trainer_request()
+    lim = job.trainer_limit()
+    cluster.create_role("grow", "trainer", 1, req, lim)
+    scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.01))
+    scaler.on_add(job)
+    scaler._apply_event(scaler._events.get_nowait())
+    target = scaler.step()
+    assert target["grow"] == 4  # 16 chips / 4 per trainer
+    assert cluster.get_trainer_parallelism("grow") == 4
+    assert len([p for p in cluster.pods if p.phase == "Running"]) == 4
+    # steady state: second pass changes nothing
+    assert scaler.step() == {}
+    assert job.status.scale_history[-1].to_replicas == 4
+
+
+def test_make_room_for_pending_job():
+    """Boss-tutorial scenario (doc/boss_tutorial.md:289-301): a new job with all
+    pods pending forces running elastic jobs to shrink toward min."""
+    cluster = FakeCluster(tpu_cluster(n_hosts=4, chips_per_host=4))
+    hog = make_job("hog", min_i=1, max_i=4, cur=4).job
+    cluster.create_role("hog", "trainer", 4, hog.trainer_request(), hog.trainer_limit())
+    newbie = make_job("newbie", min_i=1, max_i=4, cur=1).job
+    cluster.create_role("newbie", "trainer", 1, newbie.trainer_request(), newbie.trainer_limit())
+    assert all(p.phase == "Pending" for p in cluster.job_pods("newbie"))
+
+    scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.01))
+    scaler.on_add(hog)
+    scaler.on_add(newbie)
+    for _ in range(2):
+        scaler._apply_event(scaler._events.get_nowait())
+    for _ in range(5):  # a few control periods
+        scaler.step()
+    assert cluster.get_trainer_parallelism("hog") < 4
+    assert all(p.phase == "Running" for p in cluster.job_pods("newbie"))
