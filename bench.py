@@ -1,0 +1,82 @@
+"""Benchmark: CTR deep-wide steady-state throughput (samples/sec/chip).
+
+The driver's headline metric (BASELINE.json): CTR samples/sec/chip at steady
+state. The reference publishes no absolute throughput in-tree (its story is
+cluster-utilization percentages, BASELINE.md), so ``vs_baseline`` compares
+against this framework's own recorded static-mesh figure: read from
+``BENCH_BASELINE.json`` at the repo root (written once a real-TPU number
+exists) or the ``EDL_BENCH_BASELINE`` env var; until one is recorded,
+vs_baseline is reported as 1.0 (self-relative).
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    batch_size = int(os.environ.get("EDL_BENCH_BATCH", "8192"))
+    measure_steps = int(os.environ.get("EDL_BENCH_STEPS", "20"))
+    warmup_steps = 3
+
+    import jax
+    import numpy as np
+
+    devices = jax.devices()
+    n_chips = len(devices)
+
+    from edl_tpu.models import ctr
+    from edl_tpu.parallel import MeshSpec, build_mesh
+    from edl_tpu.runtime import Trainer, TrainerConfig
+
+    mesh = build_mesh(MeshSpec({"data": n_chips}), devices)
+    model = ctr.MODEL
+    trainer = Trainer(model, mesh, TrainerConfig(optimizer="adagrad", learning_rate=0.05))
+    state = trainer.init_state()
+
+    rng = np.random.default_rng(0)
+    # Pre-generate host batches so data synthesis is off the timed path.
+    host_batches = [model.synthetic_batch(rng, batch_size) for _ in range(4)]
+
+    for i in range(warmup_steps):
+        state, loss = trainer.train_step(state, trainer.place_batch(host_batches[i % 4]))
+    jax.block_until_ready(state.params["out"]["w"])
+
+    t0 = time.perf_counter()
+    for i in range(measure_steps):
+        state, loss = trainer.train_step(state, trainer.place_batch(host_batches[i % 4]))
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    samples_per_sec = measure_steps * batch_size / elapsed
+    per_chip = samples_per_sec / n_chips
+
+    baseline_per_chip = float(os.environ.get("EDL_BENCH_BASELINE", "0") or 0)
+    if baseline_per_chip <= 0:
+        baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                     "BENCH_BASELINE.json")
+        if os.path.exists(baseline_file):
+            with open(baseline_file) as f:
+                baseline_per_chip = float(json.load(f).get("samples_per_sec_per_chip", 0))
+    vs_baseline = per_chip / baseline_per_chip if baseline_per_chip > 0 else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "ctr_train_samples_per_sec_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(vs_baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Model zoo: TPU-native re-implementations of the reference workloads.
+
+Reference examples (SURVEY C14-C18) and their equivalents here:
+
+- `example/fit_a_line/train_local.py` / `train_ft.py` (linear regression)
+  -> ``fit_a_line``
+- `example/fit_a_line/train_ft.py:41-99` (5-gram word embedding)
+  -> ``word2vec`` (N-gram neural LM with a mesh-sharded embedding table)
+- `example/fit_a_line/fluid/recognize_digits.py:20-52` (softmax/MLP/conv MNIST)
+  -> ``mnist``
+- `example/ctr/ctr/train.py` (deep-wide CTR, 1e6+1 sparse features)
+  -> ``ctr`` — the flagship; its sparse tables are row-sharded over the mesh
+  (`edl_tpu.parallel.ShardedEmbedding`) instead of living on C++ pservers
+- ResNet-50 (BASELINE.json config list) -> ``resnet``
+
+Every model follows the same functional convention (``models.base.Model``):
+pure ``init``/``loss_fn`` plus sharding specs, so the elastic runtime can
+build a jit-compiled SPMD train step for any of them on any mesh.
+
+All models generate deterministic synthetic data shaped like the reference
+datasets (UCI housing, PTB-style ids, MNIST, Criteo-style CTR) — this image
+has zero egress, and the elasticity/throughput story does not depend on real
+data values.
+"""
+
+from edl_tpu.models.base import Model
+from edl_tpu.models import fit_a_line, mnist, word2vec, ctr
+
+__all__ = ["Model", "ctr", "fit_a_line", "mnist", "word2vec"]

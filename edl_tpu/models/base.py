@@ -1,0 +1,37 @@
+"""The model convention the elastic runtime trains against.
+
+A ``Model`` is a bundle of pure functions — no hidden state, no framework
+classes — so the runtime can jit/shard/checkpoint it uniformly:
+
+- ``init(key, mesh)`` -> params pytree (created sharded on the mesh).
+- ``loss_fn(params, batch, mesh)`` -> scalar loss (jit-traceable; the runtime
+  differentiates it and applies the optimizer under one jit).
+- ``param_spec(mesh)`` -> PartitionSpec pytree matching params (replicated by
+  default; big tables row-sharded).
+- ``synthetic_batch(rng, batch_size)`` -> host-side numpy batch for tests and
+  benchmarks.
+
+This replaces the reference's Paddle program construction + transpiler
+contract (`example/ctr/ctr/train.py:119-151`): there, distribution rewrites
+the graph; here, the same loss function runs on any mesh and only the specs
+change.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+Params = Any
+Batch = Dict[str, np.ndarray]
+
+
+@dataclass(frozen=True)
+class Model:
+    name: str
+    init: Callable  # (key, mesh) -> params
+    loss_fn: Callable  # (params, batch, mesh) -> scalar
+    param_spec: Callable  # (mesh) -> PartitionSpec pytree
+    synthetic_batch: Callable  # (np.random.Generator, batch_size) -> Batch

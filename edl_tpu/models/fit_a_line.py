@@ -1,0 +1,58 @@
+"""fit_a_line: linear regression, the minimum end-to-end workload.
+
+Re-design of `example/fit_a_line/train_local.py:41-109` (Paddle v2 linear
+regression on 13 housing features, SGD) as a pure-JAX model. Data is synthetic
+housing-like: y = x @ w* + noise with a fixed hidden w*, so loss convergence is
+verifiable in tests without the UCI download.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from edl_tpu.models.base import Model
+
+NUM_FEATURES = 13
+
+_TRUE_W = np.linspace(-1.0, 1.0, NUM_FEATURES).astype(np.float32)
+_TRUE_B = 0.5
+
+
+def init(key: jax.Array, mesh) -> dict:
+    wkey, _ = jax.random.split(key)
+    params = {
+        "w": jax.random.normal(wkey, (NUM_FEATURES, 1), jnp.float32) * 0.01,
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+    sharding = jax.tree_util.tree_map(
+        lambda _: NamedSharding(mesh, P()), params
+    )
+    return jax.device_put(params, sharding)
+
+
+def loss_fn(params: dict, batch: dict, mesh) -> jax.Array:
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def param_spec(mesh) -> dict:
+    return {"w": P(), "b": P()}
+
+
+def synthetic_batch(rng: np.random.Generator, batch_size: int) -> dict:
+    x = rng.standard_normal((batch_size, NUM_FEATURES), dtype=np.float32)
+    noise = 0.01 * rng.standard_normal((batch_size, 1), dtype=np.float32)
+    y = x @ _TRUE_W[:, None] + _TRUE_B + noise
+    return {"x": x, "y": y.astype(np.float32)}
+
+
+MODEL = Model(
+    name="fit_a_line",
+    init=init,
+    loss_fn=loss_fn,
+    param_spec=param_spec,
+    synthetic_batch=synthetic_batch,
+)

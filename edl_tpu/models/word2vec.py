@@ -1,0 +1,86 @@
+"""word2vec: N-gram neural word embedding with a mesh-sharded input table.
+
+Re-design of the reference's fault-tolerant elastic example
+(`example/fit_a_line/train_ft.py:41-99`): a 5-gram model — embed 4 context
+words, concat, hidden layer, softmax over the vocabulary. This was the
+reference's sparse-update pserver workload; here the input embedding is a
+`ShardedEmbedding` and the (small) softmax projection is replicated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from edl_tpu.models.base import Model
+from edl_tpu.parallel.embedding import ShardedEmbedding
+
+#: imikolov-style dict size (ref: paddle.dataset.imikolov, train_ft.py:100-104)
+VOCAB = 2074
+CONTEXT = 4  # 5-gram: 4 context words -> next word
+EMBED_DIM = 32
+HIDDEN = 256
+
+_table = ShardedEmbedding(VOCAB, EMBED_DIM, "data", "data")
+
+
+def init(key: jax.Array, mesh) -> dict:
+    k_emb, k_h, k_out = jax.random.split(key, 3)
+    replicated = NamedSharding(mesh, P())
+    fan_in = CONTEXT * EMBED_DIM
+    return {
+        "table": _table.init(k_emb, mesh, scale=1.0 / np.sqrt(EMBED_DIM)),
+        "hidden": {
+            "w": jax.device_put(
+                jax.random.normal(k_h, (fan_in, HIDDEN), jnp.float32)
+                * jnp.sqrt(2.0 / fan_in),
+                replicated,
+            ),
+            "b": jax.device_put(jnp.zeros((HIDDEN,), jnp.float32), replicated),
+        },
+        "out": {
+            "w": jax.device_put(
+                jax.random.normal(k_out, (HIDDEN, VOCAB), jnp.float32) * 0.01,
+                replicated,
+            ),
+            "b": jax.device_put(jnp.zeros((VOCAB,), jnp.float32), replicated),
+        },
+    }
+
+
+def loss_fn(params: dict, batch: dict, mesh) -> jax.Array:
+    ctx = _table.apply(mesh, params["table"], batch["context"])  # (B, 4, D)
+    h = ctx.reshape(ctx.shape[0], -1).astype(jnp.bfloat16)
+    h = jax.nn.relu(
+        jnp.dot(h, params["hidden"]["w"].astype(jnp.bfloat16))
+        + params["hidden"]["b"].astype(jnp.bfloat16)
+    )
+    logits = jnp.dot(h, params["out"]["w"].astype(jnp.bfloat16)).astype(jnp.float32)
+    logits = logits + params["out"]["b"]
+    labels = jax.nn.one_hot(batch["target"], VOCAB, dtype=jnp.float32)
+    return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
+
+
+def param_spec(mesh) -> dict:
+    return {
+        "table": _table.table_spec(),
+        "hidden": {"w": P(), "b": P()},
+        "out": {"w": P(), "b": P()},
+    }
+
+
+def synthetic_batch(rng: np.random.Generator, batch_size: int) -> dict:
+    context = (rng.zipf(1.2, size=(batch_size, CONTEXT)) % VOCAB).astype(np.int32)
+    target = (rng.zipf(1.2, size=(batch_size,)) % VOCAB).astype(np.int32)
+    return {"context": context, "target": target}
+
+
+MODEL = Model(
+    name="word2vec",
+    init=init,
+    loss_fn=loss_fn,
+    param_spec=param_spec,
+    synthetic_batch=synthetic_batch,
+)

@@ -1,0 +1,36 @@
+"""Parallelism layer: device meshes, sharding rules, sharded embeddings.
+
+This is the TPU-native replacement for the reference's entire parameter-server
+data plane (`paddle pserver` C++, sparse port pools `pkg/jobparser.go:232-247`,
+`DistributeTranspiler` graph rewriting `example/ctr/ctr/train.py:211-212`):
+
+- Dense parameters are replicated or sharded over a `jax.sharding.Mesh`;
+  gradient exchange is an ICI all-reduce XLA inserts under `jit` — no
+  gradient-server RPC protocol exists.
+- The sparse-pserver path (the reference's proto-expert-parallelism for
+  1e6-row CTR embedding tables) becomes a row-sharded embedding living in HBM
+  across the mesh, with lookups/updates done via `shard_map` + collectives
+  (`ShardedEmbedding`).
+- "Transpiling" a single-device program into a distributed one is replaced by
+  sharding annotations: same train step, any mesh.
+"""
+
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh, local_mesh
+from edl_tpu.parallel.sharding import (
+    batch_sharding,
+    named_sharding,
+    replicate,
+    shard_batch,
+)
+from edl_tpu.parallel.embedding import ShardedEmbedding
+
+__all__ = [
+    "MeshSpec",
+    "ShardedEmbedding",
+    "batch_sharding",
+    "build_mesh",
+    "local_mesh",
+    "named_sharding",
+    "replicate",
+    "shard_batch",
+]

@@ -1,0 +1,136 @@
+"""Row-sharded embedding tables: the TPU-native sparse parameter server.
+
+The reference serves large sparse embeddings (CTR's 1e6+1-row table,
+`example/ctr/ctr/train.py:60-64`) from dedicated C++ pserver processes over
+per-pserver sparse ports (`pkg/jobparser.go:232-247`, `docker/paddle_k8s:7-9`).
+Here the table is one jax array row-sharded across the mesh — each device's
+HBM holds ``vocab/N`` rows, the moral equivalent of one pserver shard — and a
+lookup is a `shard_map` collective instead of an RPC:
+
+- ids sharded on the same axis as the table (pure-DP meshes): all-gather the
+  ids, gather local rows with an ownership mask, then ``psum_scatter`` so each
+  device keeps exactly its batch slice — the classic embedding all-to-all,
+  riding ICI.
+- ids sharded on a different axis (dedicated ``expert`` axis): each row-shard
+  sees its full local batch; masked local gather + ``psum`` over the row axis.
+
+Both paths are differentiable under jit: the backward of gather/psum_scatter
+is scatter-add/all-gather, which XLA lowers to the mirror-image collective —
+this is what replaces the reference's sparse gradient push.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult
+
+
+@dataclass(frozen=True)
+class ShardedEmbedding:
+    """Config + functional init/apply for one row-sharded table.
+
+    vocab is padded up so every shard holds the same row count (XLA needs
+    static equal shards). ``shard_axis`` is the mesh axis rows live on;
+    ``batch_axis`` the axis ids/batches are sharded on (may be the same).
+    """
+
+    vocab_size: int
+    features: int
+    shard_axis: str = "data"
+    batch_axis: str = "data"
+    dtype: jnp.dtype = jnp.float32
+
+    def padded_vocab(self, mesh: Mesh) -> int:
+        n = mesh.shape[self.shard_axis] if self.shard_axis in mesh.axis_names else 1
+        return _round_up(self.vocab_size, n)
+
+    def table_spec(self) -> P:
+        return P(self.shard_axis, None)
+
+    def init(self, key: jax.Array, mesh: Mesh, scale: float = 0.01) -> jax.Array:
+        """Initialize the sharded table directly on the mesh (no host copy of
+        the full table — rows materialize shard-local, as pserver shards did)."""
+        vocab = self.padded_vocab(mesh)
+        sharding = NamedSharding(mesh, self.table_spec())
+
+        @partial(jax.jit, out_shardings=sharding)
+        def _init():
+            return (
+                jax.random.normal(key, (vocab, self.features), dtype=self.dtype)
+                * scale
+            )
+
+        return _init()
+
+    def apply(self, mesh: Mesh, table: jax.Array, ids: jax.Array) -> jax.Array:
+        """Lookup: ids (...,) int32 -> embeddings (..., features).
+
+        Out-of-range ids (e.g. the reference's hashed features modulo vocab)
+        must be pre-clipped by the caller; padded rows return real (trainable,
+        never-updated) values, matching pserver semantics for unused buckets.
+        """
+        if self.shard_axis not in mesh.axis_names or mesh.shape[self.shard_axis] == 1:
+            return table[ids]
+
+        flat = ids.reshape(-1)
+        if self.shard_axis == self.batch_axis:
+            out = self._lookup_same_axis(mesh, table, flat)
+        else:
+            out = self._lookup_cross_axis(mesh, table, flat)
+        return out.reshape(ids.shape + (self.features,))
+
+    # -- shard_map kernels -----------------------------------------------------
+
+    def _lookup_same_axis(self, mesh: Mesh, table: jax.Array, flat_ids: jax.Array):
+        axis = self.shard_axis
+        n = mesh.shape[axis]
+
+        def kernel(table_local: jax.Array, ids_local: jax.Array):
+            # (B/n,) -> (B,): everyone needs to answer everyone's queries.
+            ids_all = jax.lax.all_gather(ids_local, axis, tiled=True)
+            local_rows = table_local.shape[0]
+            offset = jax.lax.axis_index(axis) * local_rows
+            local_ids = ids_all - offset
+            hit = (local_ids >= 0) & (local_ids < local_rows)
+            safe = jnp.clip(local_ids, 0, local_rows - 1)
+            contrib = jnp.where(hit[:, None], table_local[safe], 0)
+            # Return each participant its own batch slice, summed over owners.
+            return jax.lax.psum_scatter(contrib, axis, scatter_dimension=0, tiled=True)
+
+        return shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(self.table_spec(), P(axis)),
+            out_specs=P(axis, None),
+        )(table, flat_ids)
+
+    def _lookup_cross_axis(self, mesh: Mesh, table: jax.Array, flat_ids: jax.Array):
+        shard_ax, batch_ax = self.shard_axis, self.batch_axis
+        batch_spec = P(batch_ax) if batch_ax in mesh.axis_names else P()
+
+        def kernel(table_local: jax.Array, ids_local: jax.Array):
+            local_rows = table_local.shape[0]
+            offset = jax.lax.axis_index(shard_ax) * local_rows
+            local_ids = ids_local - offset
+            hit = (local_ids >= 0) & (local_ids < local_rows)
+            safe = jnp.clip(local_ids, 0, local_rows - 1)
+            contrib = jnp.where(hit[:, None], table_local[safe], 0)
+            return jax.lax.psum(contrib, shard_ax)
+
+        out_spec = P(batch_ax, None) if batch_ax in mesh.axis_names else P(None, None)
+        return shard_map(
+            kernel,
+            mesh=mesh,
+            in_specs=(self.table_spec(), batch_spec),
+            out_specs=out_spec,
+        )(table, flat_ids)

@@ -1,0 +1,93 @@
+"""Device-mesh construction from TrainingJob parallelism specs.
+
+The reference distributes by counting processes (`PADDLE_INIT_NUM_GRADIENT_SERVERS`,
+`pkg/jobparser.go:296`) and wiring endpoints; here distribution is a mesh of
+TPU chips with named logical axes. The trainer count the autoscaler actuates
+multiplies the ``data`` axis: a job scaled from 2 to 4 trainers rebuilds its
+mesh with twice the data-parallel degree (checkpoint-restore rescale, see
+`edl_tpu.runtime.elastic`).
+
+Axis conventions (scaling-book style):
+  data    — batch sharding; gradients all-reduced over it (ICI)
+  model   — tensor-parallel sharding of weight matrices
+  seq     — sequence/context parallelism for long inputs
+  expert  — expert/embedding-row sharding (the pserver-replacement axis)
+
+All axes are optional; absent axes have size 1. The product of axis sizes must
+equal the number of participating devices.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("data", "seq", "expert", "model")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical axis sizes for a job's mesh.
+
+    ``axes`` maps axis name -> size; unspecified axes are size 1. Built from
+    ``TrainingJobSpec.parallelism`` (per-trainer local factors) times the
+    actuated trainer count on the data axis.
+    """
+
+    axes: Dict[str, int] = field(default_factory=dict)
+
+    def size(self) -> int:
+        return math.prod(self.axes.values()) if self.axes else 1
+
+    def axis(self, name: str) -> int:
+        return self.axes.get(name, 1)
+
+    def ordered_axes(self) -> List[str]:
+        """Axes in canonical order: data outermost (spans hosts — its
+        collectives tolerate DCN), model innermost (highest-bandwidth ICI
+        neighbors — tensor-parallel collectives are latency-critical)."""
+        named = [a for a in AXIS_ORDER if a in self.axes]
+        extra = [a for a in self.axes if a not in AXIS_ORDER]
+        return named + sorted(extra)
+
+    @classmethod
+    def for_job(cls, parallelism: Dict[str, int], num_trainers: int = 1) -> "MeshSpec":
+        axes = {k: int(v) for k, v in parallelism.items() if int(v) > 1}
+        if num_trainers > 1:
+            axes["data"] = axes.get("data", 1) * num_trainers
+        if not axes:
+            axes = {"data": 1}
+        return cls(axes=axes)
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a `jax.sharding.Mesh` for the spec.
+
+    Uses every available device by default and requires the axis product to
+    match the device count exactly — a mismatch means the controller's
+    actuated trainer count and the runtime's world view disagree, which must
+    fail loudly (the reference's equivalent failure was trainers blocking on
+    `wait_pods_running` forever, `docker/k8s_tools.py:70-78`).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    want = spec.size()
+    if want != len(devs):
+        raise ValueError(
+            f"mesh spec {spec.axes} needs {want} devices, have {len(devs)}"
+        )
+    names = spec.ordered_axes() or ["data"]
+    shape = [spec.axis(n) for n in names]
+    mesh_devices = np.array(devs).reshape(shape)
+    return Mesh(mesh_devices, axis_names=tuple(names))
+
+
+def local_mesh(axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Single-host mesh over all local devices; default one flat data axis."""
+    devs = jax.devices()
+    spec = MeshSpec(axes=dict(axes) if axes else {"data": len(devs)})
+    return build_mesh(spec, devs)

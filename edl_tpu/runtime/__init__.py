@@ -1,0 +1,11 @@
+"""Trainer runtime: SPMD train loops, data leases, checkpoints, elasticity.
+
+The TPU-native replacement for the reference's L1 training runtime (external
+`paddle train`/`paddle pserver` binaries + `cloud_reader`, SURVEY §2.2): a
+jit-compiled train step over a device mesh, a coordinator-leased data pipeline,
+orbax async checkpoints, and checkpoint-restore mesh rescale.
+"""
+
+from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
+
+__all__ = ["TrainState", "Trainer", "TrainerConfig"]

@@ -1,19 +1,30 @@
-"""Test harness: force an 8-device virtual CPU mesh before JAX import.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 Mirrors the reference's testing insight (SURVEY §4): multi-"node" behavior is
 tested hermetically on one host — the reference used fake clientsets
 (`pkg/client/.../fake`); we use fake cluster providers plus a virtual 8-device
 CPU platform so every sharding/collective path compiles and runs without TPUs.
+
+Note: this image's sitecustomize registers the axon TPU-tunnel backend at
+interpreter startup and force-selects ``jax_platforms=axon,cpu``, ignoring the
+JAX_PLATFORMS env var. Tests must run on CPU (the tunnel serves one real chip
+and is slow to dial), so we override the config back *after* import — backends
+have not initialized yet at conftest time, so the override takes effect.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS is read at backend-init time, which happens after conftest.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
