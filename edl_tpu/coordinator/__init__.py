@@ -1,0 +1,25 @@
+"""Coordinator service: membership epochs, leased task queue, barriers, KV.
+
+Python side of the native C++ coordinator (`native/coordinator/coordinator.cc`)
+— the consolidated replacement for the reference's fault-tolerant master +
+etcd sidecar + pserver self-registration (SURVEY §2.2). Provides:
+
+- ``CoordinatorClient`` — blocking TCP client speaking the newline-JSON
+  protocol; what trainers embed.
+- ``CoordinatorServer`` — spawns/manages the C++ binary (builds it on first
+  use if the toolchain is present).
+- ``InProcessCoordinator`` — pure-Python twin of the C++ state machine for
+  hermetic unit tests (the role the fake clientset plays in the reference,
+  `pkg/client/.../fake`).
+"""
+
+from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
+from edl_tpu.coordinator.inprocess import InProcessCoordinator
+from edl_tpu.coordinator.server import CoordinatorServer
+
+__all__ = [
+    "CoordinatorClient",
+    "CoordinatorError",
+    "CoordinatorServer",
+    "InProcessCoordinator",
+]

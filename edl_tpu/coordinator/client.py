@@ -1,0 +1,160 @@
+"""Blocking TCP client for the coordinator's newline-JSON protocol.
+
+The trainer-side embed: replaces the reference's etcd client + master RPC in
+`train_ft.py` (`SGD(pserver_spec=etcd_endpoint, use_etcd=True)`,
+`cloud_reader` task pulls, `example/fit_a_line/train_ft.py:105-114`) and the
+pod launcher's poll-and-sleep discovery (`docker/k8s_tools.py:70-78`).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time
+from typing import Dict, List, Optional
+
+
+class CoordinatorError(RuntimeError):
+    pass
+
+
+class CoordinatorClient:
+    """One persistent connection; requests are serialized (1 req -> 1 reply),
+    except ``barrier`` which blocks until the coordinator releases it."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 7164,
+                 worker: str = "", connect_timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.worker = worker
+        self._sock: Optional[socket.socket] = None
+        self._buf = b""
+        self._connect(connect_timeout)
+
+    def _connect(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                sock = socket.create_connection((self.host, self.port), timeout=5.0)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                sock.settimeout(None)
+                self._sock = sock
+                return
+            except OSError as e:
+                last_err = e
+                time.sleep(0.1)
+        raise CoordinatorError(
+            f"cannot connect to coordinator at {self.host}:{self.port}: {last_err}"
+        )
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- protocol --------------------------------------------------------------
+
+    def call(self, op: str, timeout: Optional[float] = None, **fields) -> Dict:
+        if self._sock is None:
+            # A previous timeout/error poisoned the connection (a late reply
+            # may still be in flight, which would desync request/reply
+            # pairing) — start a fresh one.
+            self._buf = b""
+            self._connect(5.0)
+        req = {"op": op, **fields}
+        if self.worker and "worker" not in req:
+            req["worker"] = self.worker
+        payload = (json.dumps(req, ensure_ascii=False) + "\n").encode()
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.sendall(payload)
+            while b"\n" not in self._buf:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise CoordinatorError("coordinator closed connection")
+                self._buf += chunk
+        except socket.timeout as e:
+            self.close()  # poison: the reply may arrive later on this socket
+            raise CoordinatorError(f"coordinator call {op!r} timed out") from e
+        except OSError as e:
+            self.close()
+            raise CoordinatorError(f"coordinator call {op!r} failed: {e}") from e
+        finally:
+            if self._sock is not None:
+                self._sock.settimeout(None)
+        line, self._buf = self._buf.split(b"\n", 1)
+        return json.loads(line)
+
+    # -- membership ------------------------------------------------------------
+
+    def register(self) -> Dict:
+        return self.call("register")
+
+    def heartbeat(self) -> Dict:
+        return self.call("heartbeat")
+
+    def leave(self) -> Dict:
+        return self.call("leave")
+
+    def members(self) -> List[str]:
+        return self.call("members")["members"]
+
+    def epoch(self) -> int:
+        return int(self.call("status")["epoch"])
+
+    # -- task queue ------------------------------------------------------------
+
+    def add_tasks(self, tasks: List[str]) -> int:
+        return int(self.call("add_tasks", tasks=list(tasks))["added"])
+
+    def acquire_task(self) -> Optional[str]:
+        return self.call("acquire_task").get("task")
+
+    def acquire(self) -> Dict:
+        """Full acquire reply: {task: str|None, exhausted: bool when drained}."""
+        return self.call("acquire_task")
+
+    def complete_task(self, task: str) -> Dict:
+        return self.call("complete_task", task=task)
+
+    def fail_task(self, task: str) -> Dict:
+        return self.call("fail_task", task=task)
+
+    # -- synchronization -------------------------------------------------------
+
+    def barrier(self, name: str, count: int, timeout: float = 120.0) -> Dict:
+        """Block until ``count`` distinct workers arrive at ``name``.
+
+        Replaces the launcher's sleep-and-poll barriers
+        (docker/paddle_k8s:128-130,178) with a real rendezvous.
+        """
+        return self.call("barrier", timeout=timeout, name=name, count=count)
+
+    # -- KV (etcd-role subset) -------------------------------------------------
+
+    def kv_put(self, key: str, value: str) -> None:
+        self.call("kv_put", key=key, value=value)
+
+    def kv_get(self, key: str) -> Optional[str]:
+        return self.call("kv_get", key=key).get("value")
+
+    def kv_del(self, key: str) -> None:
+        self.call("kv_del", key=key)
+
+    def status(self) -> Dict:
+        return self.call("status")
+
+    def ping(self) -> bool:
+        try:
+            return bool(self.call("ping", timeout=5.0).get("pong"))
+        except (CoordinatorError, OSError):
+            return False

@@ -1,0 +1,279 @@
+"""Pure-Python twin of the C++ coordinator state machine.
+
+Same semantics as `native/coordinator/coordinator.cc` (membership epochs,
+dense re-ranking, 16s-style task leases with requeue, generation-counted
+barriers, KV), behind the same client method surface — so tests and the
+single-host launcher can run hermetically without the native binary, exactly
+the role the reference's in-memory fake clientset plays
+(`pkg/client/clientset/versioned/fake/`). Thread-safe; barriers block on a
+Condition instead of a parked socket.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set
+
+
+class InProcessCoordinator:
+    def __init__(self, task_lease_sec: float = 16.0, heartbeat_ttl_sec: float = 10.0):
+        self.task_lease_sec = task_lease_sec
+        self.heartbeat_ttl_sec = heartbeat_ttl_sec
+        self._lock = threading.RLock()
+        self._barrier_cv = threading.Condition(self._lock)
+        self._epoch = 0
+        self._next_rank = 0
+        self._members: Dict[str, Dict] = {}  # name -> {rank, last_heartbeat}
+        self._todo: deque = deque()
+        self._leased: Dict[str, Dict] = {}  # task -> {worker, deadline}
+        self._done: Set[str] = set()
+        self._barriers: Dict[str, Dict] = {}  # name -> {arrived, generation}
+        self._kv: Dict[str, str] = {}
+
+    # -- expiry ---------------------------------------------------------------
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        dead = [
+            n for n, m in self._members.items()
+            if m["last_heartbeat"] + self.heartbeat_ttl_sec <= now
+        ]
+        for name in dead:
+            self._drop_member(name)
+        expired = [t for t, l in self._leased.items() if l["deadline"] <= now]
+        for t in expired:
+            del self._leased[t]
+            self._todo.append(t)
+
+    def _drop_member(self, name: str) -> None:
+        if name not in self._members:
+            return
+        del self._members[name]
+        by_rank = sorted(self._members.items(), key=lambda kv: kv[1]["rank"])
+        for r, (n, m) in enumerate(by_rank):
+            m["rank"] = r
+        self._next_rank = len(self._members)
+        self._epoch += 1
+        back = [t for t, l in self._leased.items() if l["worker"] == name]
+        for t in back:
+            del self._leased[t]
+            self._todo.append(t)
+
+    def _membership_reply(self, worker: str) -> Dict:
+        m = self._members.get(worker)
+        return {
+            "ok": True,
+            "rank": m["rank"] if m else -1,
+            "epoch": self._epoch,
+            "world": len(self._members),
+        }
+
+    # -- ops (mirror the C++ op_* handlers) -----------------------------------
+
+    def register(self, worker: str) -> Dict:
+        with self._lock:
+            self._tick()
+            if worker not in self._members:
+                self._members[worker] = {
+                    "rank": self._next_rank,
+                    "last_heartbeat": time.monotonic(),
+                }
+                self._next_rank += 1
+                self._epoch += 1
+            else:
+                self._members[worker]["last_heartbeat"] = time.monotonic()
+            return self._membership_reply(worker)
+
+    def heartbeat(self, worker: str) -> Dict:
+        with self._lock:
+            self._tick()
+            if worker not in self._members:
+                return {"ok": False, "error": "unknown worker", "epoch": self._epoch}
+            self._members[worker]["last_heartbeat"] = time.monotonic()
+            return self._membership_reply(worker)
+
+    def leave(self, worker: str) -> Dict:
+        with self._lock:
+            self._tick()
+            self._drop_member(worker)
+            return {"ok": True, "epoch": self._epoch}
+
+    def members(self) -> List[str]:
+        with self._lock:
+            self._tick()
+            return [
+                n for n, _ in sorted(
+                    self._members.items(), key=lambda kv: kv[1]["rank"]
+                )
+            ]
+
+    def epoch(self) -> int:
+        with self._lock:
+            self._tick()
+            return self._epoch
+
+    def add_tasks(self, tasks: List[str]) -> int:
+        with self._lock:
+            self._tick()
+            added = 0
+            for t in tasks:
+                if t in self._done or t in self._leased or t in self._todo:
+                    continue
+                self._todo.append(t)
+                added += 1
+            return added
+
+    def acquire(self, worker: str) -> Dict:
+        with self._lock:
+            self._tick()
+            if not self._todo:
+                return {"ok": True, "task": None, "exhausted": not self._leased}
+            task = self._todo.popleft()
+            self._leased[task] = {
+                "worker": worker,
+                "deadline": time.monotonic() + self.task_lease_sec,
+            }
+            return {"ok": True, "task": task, "lease_sec": self.task_lease_sec}
+
+    def acquire_task(self, worker: str) -> Optional[str]:
+        return self.acquire(worker).get("task")
+
+    def complete_task(self, worker: str, task: str) -> Dict:
+        with self._lock:
+            self._tick()
+            if task not in self._leased:
+                return {"ok": False, "error": "not leased"}
+            if self._leased[task]["worker"] != worker:
+                return {"ok": False, "error": "lease not owned"}
+            del self._leased[task]
+            self._done.add(task)
+            return {"ok": True, "done": len(self._done), "queued": len(self._todo)}
+
+    def fail_task(self, worker: str, task: str) -> Dict:
+        with self._lock:
+            self._tick()
+            if task not in self._leased:
+                return {"ok": False, "error": "not leased"}
+            if self._leased[task]["worker"] != worker:
+                return {"ok": False, "error": "lease not owned"}
+            del self._leased[task]
+            self._todo.append(task)
+            return {"ok": True}
+
+    def barrier(self, worker: str, name: str, count: int, timeout: float = 120.0) -> Dict:
+        with self._barrier_cv:
+            b = self._barriers.setdefault(name, {"arrived": set(), "generation": 0})
+            gen = b["generation"]
+            b["arrived"].add(worker)
+            if len(b["arrived"]) >= count:
+                b["generation"] += 1
+                b["arrived"] = set()
+                self._barrier_cv.notify_all()
+                return {"ok": True, "barrier": name, "generation": gen}
+            deadline = time.monotonic() + timeout
+            while b["generation"] == gen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    b["arrived"].discard(worker)
+                    return {"ok": False, "error": "barrier timeout"}
+                self._barrier_cv.wait(remaining)
+            return {"ok": True, "barrier": name, "generation": gen}
+
+    def kv_put(self, key: str, value: str) -> None:
+        with self._lock:
+            self._kv[key] = value
+
+    def kv_get(self, key: str) -> Optional[str]:
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def status(self) -> Dict:
+        with self._lock:
+            self._tick()
+            return {
+                "ok": True,
+                "epoch": self._epoch,
+                "world": len(self._members),
+                "queued": len(self._todo),
+                "leased": len(self._leased),
+                "done": len(self._done),
+            }
+
+    def ping(self) -> bool:
+        return True
+
+    # -- client-compatible facade ---------------------------------------------
+
+    def client(self, worker: str = "") -> "InProcessClient":
+        return InProcessClient(self, worker)
+
+
+class InProcessClient:
+    """Same method surface as CoordinatorClient, bound to one worker name."""
+
+    def __init__(self, coord: InProcessCoordinator, worker: str):
+        self._c = coord
+        self.worker = worker
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def register(self):
+        return self._c.register(self.worker)
+
+    def heartbeat(self):
+        return self._c.heartbeat(self.worker)
+
+    def leave(self):
+        return self._c.leave(self.worker)
+
+    def members(self):
+        return self._c.members()
+
+    def epoch(self):
+        return self._c.epoch()
+
+    def add_tasks(self, tasks):
+        return self._c.add_tasks(tasks)
+
+    def acquire_task(self):
+        return self._c.acquire_task(self.worker)
+
+    def acquire(self):
+        return self._c.acquire(self.worker)
+
+    def complete_task(self, task):
+        return self._c.complete_task(self.worker, task)
+
+    def fail_task(self, task):
+        return self._c.fail_task(self.worker, task)
+
+    def barrier(self, name, count, timeout=120.0):
+        return self._c.barrier(self.worker, name, count, timeout)
+
+    def kv_put(self, key, value):
+        return self._c.kv_put(key, value)
+
+    def kv_get(self, key):
+        return self._c.kv_get(key)
+
+    def kv_del(self, key):
+        return self._c.kv_del(key)
+
+    def status(self):
+        return self._c.status()
+
+    def ping(self):
+        return True

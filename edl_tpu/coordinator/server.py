@@ -1,0 +1,110 @@
+"""Lifecycle manager for the native coordinator binary.
+
+Builds `native/coordinator` on first use (make), spawns it as a subprocess on
+a free port, and tears it down — the role the controller's master-ReplicaSet
+materialization plays in the reference (`pkg/controller.go:119-134`,
+`pkg/jobparser.go:167-227`), minus Kubernetes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import time
+from typing import Optional
+
+from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native", "coordinator")
+BINARY = os.path.join(NATIVE_DIR, "edl-coordinator")
+
+
+def ensure_built(timeout: float = 120.0) -> str:
+    """Build the coordinator binary if missing; returns its path."""
+    if os.path.exists(BINARY):
+        return BINARY
+    proc = subprocess.run(
+        ["make", "-C", NATIVE_DIR],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    if proc.returncode != 0 or not os.path.exists(BINARY):
+        raise CoordinatorError(
+            f"failed to build coordinator: {proc.stdout}\n{proc.stderr}"
+        )
+    return BINARY
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class CoordinatorServer:
+    """Spawn/own one coordinator process."""
+
+    def __init__(
+        self,
+        port: Optional[int] = None,
+        task_lease_sec: float = 16.0,  # ref: -task-timout-dur 16s
+        heartbeat_ttl_sec: float = 10.0,
+    ):
+        self.port = port or free_port()
+        self.task_lease_sec = task_lease_sec
+        self.heartbeat_ttl_sec = heartbeat_ttl_sec
+        self._proc: Optional[subprocess.Popen] = None
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self, wait: float = 10.0) -> "CoordinatorServer":
+        binary = ensure_built()
+        self._proc = subprocess.Popen(
+            [
+                binary,
+                "--port", str(self.port),
+                "--task-lease-sec", str(self.task_lease_sec),
+                "--heartbeat-ttl-sec", str(self.heartbeat_ttl_sec),
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline:
+            try:
+                with CoordinatorClient(port=self.port, connect_timeout=0.5) as c:
+                    if c.ping():
+                        return self
+            except CoordinatorError:
+                pass
+            if self._proc.poll() is not None:
+                rc = self._proc.returncode
+                self._proc = None
+                raise CoordinatorError(f"coordinator exited at startup (rc={rc})")
+            time.sleep(0.05)
+        self.stop()  # don't leak the subprocess (and its port) on timeout
+        raise CoordinatorError("coordinator did not become ready")
+
+    def stop(self) -> None:
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+            self._proc = None
+
+    def client(self, worker: str = "") -> CoordinatorClient:
+        return CoordinatorClient(port=self.port, worker=worker)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -1,0 +1,735 @@
+// edl-coordinator: elastic-training coordination service.
+//
+// TPU-native consolidation of three reference components (SURVEY §2.2):
+//   * /usr/bin/master     — fault-tolerant chunked task queue with leases
+//                           (-chunk-per-task, -task-timout-dur 16s;
+//                           docker/paddle_k8s:26-32)
+//   * etcd sidecar        — service discovery / KV / membership
+//                           (pkg/jobparser.go:167-184)
+//   * /usr/bin/pserver's  — self-registration & peer-count discovery
+//     registration role     (docker/paddle_k8s:18-23)
+//
+// One process, one poll() event loop, zero dependencies. Protocol:
+// newline-delimited JSON over TCP. Workers register (-> rank, membership
+// epoch), heartbeat (leases expire like etcd TTLs), lease data-shard tasks
+// (expired leases requeue: at-least-once, exactly the master's semantics),
+// hit named barriers (replacing the reference's `sleep 20` + poll loops,
+// docker/paddle_k8s:128-130,178), and read/write a small KV namespace
+// (checkpoint metadata, coordinator bootstrap info).
+//
+// Membership epochs drive elasticity: any join/leave/expiry bumps the epoch;
+// trainers see the new epoch on their next heartbeat and enter the
+// checkpoint -> rebuild-mesh -> restore rescale path (edl_tpu.runtime.elastic).
+//
+// Build: make (or cmake).
+// Run: edl-coordinator --port 7164 [--task-lease-sec 16] [--heartbeat-ttl-sec 10]
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <deque>
+#include <map>
+#include <set>
+#include <string>
+#include <vector>
+
+namespace {
+
+double now_sec() {
+  using namespace std::chrono;
+  return duration<double>(steady_clock::now().time_since_epoch()).count();
+}
+
+// ---------------------------------------------------------------------------
+// Minimal JSON: enough for flat objects with string / double / bool values
+// and arrays of strings. Task payloads and KV values are opaque strings.
+// ---------------------------------------------------------------------------
+
+struct JsonValue {
+  enum Kind { kNull, kString, kNumber, kBool, kStrArray } kind = kNull;
+  std::string str;
+  double num = 0;
+  bool b = false;
+  std::vector<std::string> arr;
+};
+
+using JsonObject = std::map<std::string, JsonValue>;
+
+struct JsonParser {
+  const char* p;
+  const char* end;
+  bool ok = true;
+
+  explicit JsonParser(const std::string& s) : p(s.data()), end(s.data() + s.size()) {}
+
+  void skip_ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r' || *p == '\n')) p++;
+  }
+  bool consume(char c) {
+    skip_ws();
+    if (p < end && *p == c) { p++; return true; }
+    return false;
+  }
+  bool parse_string(std::string* out) {
+    skip_ws();
+    if (p >= end || *p != '"') return false;
+    p++;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        p++;
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'u': {
+            // \uXXXX -> UTF-8 (BMP only; surrogate pairs unsupported —
+            // clients send raw UTF-8 for non-ASCII, this path mainly
+            // round-trips our own \u00XX control-char escapes).
+            if (end - p >= 5) {
+              char hex[5] = {p[1], p[2], p[3], p[4], 0};
+              unsigned cp = (unsigned)strtoul(hex, nullptr, 16);
+              p += 4;
+              if (cp < 0x80) {
+                out->push_back((char)cp);
+              } else if (cp < 0x800) {
+                out->push_back((char)(0xC0 | (cp >> 6)));
+                out->push_back((char)(0x80 | (cp & 0x3F)));
+              } else {
+                out->push_back((char)(0xE0 | (cp >> 12)));
+                out->push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+                out->push_back((char)(0x80 | (cp & 0x3F)));
+              }
+            }
+            break;
+          }
+          default: out->push_back(*p); break;
+        }
+      } else {
+        out->push_back(*p);
+      }
+      p++;
+    }
+    if (p >= end) return false;
+    p++;  // closing quote
+    return true;
+  }
+  bool parse_value(JsonValue* v) {
+    skip_ws();
+    if (p >= end) return false;
+    if (*p == '"') {
+      v->kind = JsonValue::kString;
+      return parse_string(&v->str);
+    }
+    if (*p == 't') {
+      if (end - p >= 4 && strncmp(p, "true", 4) == 0) { p += 4; v->kind = JsonValue::kBool; v->b = true; return true; }
+      return false;
+    }
+    if (*p == 'f') {
+      if (end - p >= 5 && strncmp(p, "false", 5) == 0) { p += 5; v->kind = JsonValue::kBool; v->b = false; return true; }
+      return false;
+    }
+    if (*p == 'n') {
+      if (end - p >= 4 && strncmp(p, "null", 4) == 0) { p += 4; v->kind = JsonValue::kNull; return true; }
+      return false;
+    }
+    if (*p == '[') {
+      p++;
+      v->kind = JsonValue::kStrArray;
+      skip_ws();
+      if (p < end && *p == ']') { p++; return true; }
+      while (true) {
+        std::string s;
+        if (!parse_string(&s)) return false;
+        v->arr.push_back(std::move(s));
+        skip_ws();
+        if (p < end && *p == ',') { p++; continue; }
+        if (p < end && *p == ']') { p++; return true; }
+        return false;
+      }
+    }
+    // number
+    char* numend = nullptr;
+    v->num = strtod(p, &numend);
+    if (numend == p) return false;
+    v->kind = JsonValue::kNumber;
+    p = numend;
+    return true;
+  }
+  bool parse_object(JsonObject* obj) {
+    if (!consume('{')) return false;
+    skip_ws();
+    if (p < end && *p == '}') { p++; return true; }
+    while (true) {
+      std::string key;
+      if (!parse_string(&key)) return false;
+      if (!consume(':')) return false;
+      JsonValue v;
+      if (!parse_value(&v)) return false;
+      (*obj)[std::move(key)] = std::move(v);
+      skip_ws();
+      if (p < end && *p == ',') { p++; continue; }
+      if (p < end && *p == '}') { p++; return true; }
+      return false;
+    }
+  }
+};
+
+void json_escape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      case '\r': *out += "\\r"; break;
+      default:
+        if ((unsigned char)c < 0x20) {
+          // Strict JSON readers reject raw control chars; payloads are
+          // documented as opaque strings, so escape them.
+          char tmp[8];
+          snprintf(tmp, sizeof tmp, "\\u%04x", c);
+          *out += tmp;
+        } else {
+          out->push_back(c);
+        }
+        break;
+    }
+  }
+}
+
+class JsonWriter {
+ public:
+  JsonWriter() { buf_ = "{"; }
+  JsonWriter& field(const std::string& k, const std::string& v) {
+    key(k); buf_ += '"'; json_escape(v, &buf_); buf_ += '"'; return *this;
+  }
+  // Without this, a string literal binds to the bool overload (pointer ->
+  // bool conversion outranks const char* -> std::string).
+  JsonWriter& field(const std::string& k, const char* v) {
+    return field(k, std::string(v));
+  }
+  JsonWriter& field(const std::string& k, double v) {
+    key(k);
+    char tmp[32];
+    if (v == (long long)v) snprintf(tmp, sizeof tmp, "%lld", (long long)v);
+    else snprintf(tmp, sizeof tmp, "%.17g", v);
+    buf_ += tmp;
+    return *this;
+  }
+  JsonWriter& field(const std::string& k, bool v) {
+    key(k); buf_ += v ? "true" : "false"; return *this;
+  }
+  JsonWriter& field_null(const std::string& k) { key(k); buf_ += "null"; return *this; }
+  JsonWriter& field(const std::string& k, const std::vector<std::string>& v) {
+    key(k);
+    buf_ += '[';
+    for (size_t i = 0; i < v.size(); i++) {
+      if (i) buf_ += ',';
+      buf_ += '"'; json_escape(v[i], &buf_); buf_ += '"';
+    }
+    buf_ += ']';
+    return *this;
+  }
+  std::string done() { return buf_ + "}\n"; }
+
+ private:
+  void key(const std::string& k) {
+    if (buf_.size() > 1) buf_ += ',';
+    buf_ += '"'; json_escape(k, &buf_); buf_ += "\":";
+  }
+  std::string buf_;
+};
+
+// ---------------------------------------------------------------------------
+// Coordinator state
+// ---------------------------------------------------------------------------
+
+struct Member {
+  int rank = -1;
+  double last_heartbeat = 0;
+};
+
+struct Lease {
+  std::string task;
+  std::string worker;
+  double deadline = 0;
+};
+
+struct BarrierWaiter {
+  int fd;
+  std::string worker;
+};
+
+struct Barrier {
+  int want = 0;
+  std::set<std::string> arrived;
+  std::vector<BarrierWaiter> waiters;
+  long long generation = 0;  // completed cycles, for reuse across steps
+};
+
+struct Conn {
+  int fd = -1;
+  std::string inbuf;
+  std::string outbuf;
+  bool closing = false;
+};
+
+class Coordinator {
+ public:
+  Coordinator(double task_lease_sec, double heartbeat_ttl_sec)
+      : task_lease_sec_(task_lease_sec), heartbeat_ttl_sec_(heartbeat_ttl_sec) {}
+
+  // Returns the response line (possibly empty when the reply is deferred,
+  // e.g. a barrier waiter parked until the barrier fills).
+  std::string handle(const JsonObject& req, int fd);
+
+  // Expire heartbeats and task leases; returns seconds until next deadline.
+  double tick();
+
+  // Deferred barrier releases accumulated by handle()/tick(): fd -> line.
+  std::vector<std::pair<int, std::string>> take_deferred() {
+    auto out = std::move(deferred_);
+    deferred_.clear();
+    return out;
+  }
+
+  void on_disconnect(int fd);
+
+ private:
+  std::string op_register(const JsonObject& req);
+  std::string op_heartbeat(const JsonObject& req);
+  std::string op_leave(const JsonObject& req);
+  std::string op_members();
+  std::string op_add_tasks(const JsonObject& req);
+  std::string op_acquire_task(const JsonObject& req);
+  std::string op_complete_task(const JsonObject& req);
+  std::string op_fail_task(const JsonObject& req);
+  std::string op_barrier(const JsonObject& req, int fd);
+  std::string op_kv_put(const JsonObject& req);
+  std::string op_kv_get(const JsonObject& req);
+  std::string op_kv_del(const JsonObject& req);
+  std::string op_status();
+
+  void bump_epoch() { epoch_++; }
+  void drop_member(const std::string& name);
+  void requeue_expired_leases(double now);
+  std::string membership_reply(const std::string& worker, bool ok_rank);
+
+  static std::string get_str(const JsonObject& o, const std::string& k) {
+    auto it = o.find(k);
+    return (it != o.end() && it->second.kind == JsonValue::kString) ? it->second.str : "";
+  }
+  static double get_num(const JsonObject& o, const std::string& k, double dflt) {
+    auto it = o.find(k);
+    return (it != o.end() && it->second.kind == JsonValue::kNumber) ? it->second.num : dflt;
+  }
+
+  double task_lease_sec_;
+  double heartbeat_ttl_sec_;
+  long long epoch_ = 0;
+  int next_rank_ = 0;
+  std::map<std::string, Member> members_;
+  std::deque<std::string> todo_;
+  std::map<std::string, Lease> leased_;   // task -> lease
+  std::set<std::string> done_;
+  std::map<std::string, Barrier> barriers_;
+  std::map<std::string, std::string> kv_;
+  std::vector<std::pair<int, std::string>> deferred_;
+};
+
+void Coordinator::drop_member(const std::string& name) {
+  if (members_.erase(name)) {
+    // Re-rank compactly: ranks are 0..N-1 in registration order of survivors
+    // (the reference recomputed ranks from the sorted live-pod list,
+    // docker/k8s_tools.py:127-151 — same effect: dense, stable order).
+    std::map<int, std::string> by_rank;
+    for (auto& [n, m] : members_) by_rank[m.rank] = n;
+    int r = 0;
+    for (auto& [_, n] : by_rank) members_[n].rank = r++;
+    next_rank_ = r;
+    bump_epoch();
+    // Requeue this worker's leases immediately: a departed trainer's chunk
+    // goes back to the queue (master semantics on task timeout).
+    std::vector<std::string> back;
+    for (auto& [task, lease] : leased_)
+      if (lease.worker == name) back.push_back(task);
+    for (auto& t : back) {
+      leased_.erase(t);
+      todo_.push_back(t);
+    }
+  }
+}
+
+void Coordinator::requeue_expired_leases(double now) {
+  std::vector<std::string> back;
+  for (auto& [task, lease] : leased_)
+    if (lease.deadline <= now) back.push_back(task);
+  for (auto& t : back) {
+    leased_.erase(t);
+    todo_.push_back(t);
+  }
+}
+
+double Coordinator::tick() {
+  double now = now_sec();
+  // Heartbeat expiry -> membership change -> epoch bump.
+  std::vector<std::string> dead;
+  for (auto& [name, m] : members_)
+    if (m.last_heartbeat + heartbeat_ttl_sec_ <= now) dead.push_back(name);
+  for (auto& name : dead) drop_member(name);
+  requeue_expired_leases(now);
+
+  double next = 60.0;
+  for (auto& [_, m] : members_)
+    next = std::min(next, m.last_heartbeat + heartbeat_ttl_sec_ - now);
+  for (auto& [_, l] : leased_) next = std::min(next, l.deadline - now);
+  return std::max(0.05, next);
+}
+
+std::string Coordinator::membership_reply(const std::string& worker, bool ok) {
+  JsonWriter w;
+  w.field("ok", ok);
+  auto it = members_.find(worker);
+  w.field("rank", it != members_.end() ? (double)it->second.rank : -1.0);
+  w.field("epoch", (double)epoch_);
+  w.field("world", (double)members_.size());
+  return w.done();
+}
+
+std::string Coordinator::op_register(const JsonObject& req) {
+  std::string worker = get_str(req, "worker");
+  if (worker.empty()) return JsonWriter().field("ok", false).field("error", "worker required").done();
+  auto it = members_.find(worker);
+  if (it == members_.end()) {
+    members_[worker] = Member{next_rank_++, now_sec()};
+    bump_epoch();
+  } else {
+    it->second.last_heartbeat = now_sec();  // re-register == refresh
+  }
+  return membership_reply(worker, true);
+}
+
+std::string Coordinator::op_heartbeat(const JsonObject& req) {
+  std::string worker = get_str(req, "worker");
+  auto it = members_.find(worker);
+  if (it == members_.end())
+    return JsonWriter().field("ok", false).field("error", "unknown worker")
+        .field("epoch", (double)epoch_).done();
+  it->second.last_heartbeat = now_sec();
+  return membership_reply(worker, true);
+}
+
+std::string Coordinator::op_leave(const JsonObject& req) {
+  std::string worker = get_str(req, "worker");
+  drop_member(worker);
+  return JsonWriter().field("ok", true).field("epoch", (double)epoch_).done();
+}
+
+std::string Coordinator::op_members() {
+  std::map<int, std::string> by_rank;
+  for (auto& [n, m] : members_) by_rank[m.rank] = n;
+  std::vector<std::string> names;
+  for (auto& [_, n] : by_rank) names.push_back(n);
+  return JsonWriter().field("ok", true).field("members", names)
+      .field("epoch", (double)epoch_).done();
+}
+
+std::string Coordinator::op_add_tasks(const JsonObject& req) {
+  auto it = req.find("tasks");
+  if (it == req.end() || it->second.kind != JsonValue::kStrArray)
+    return JsonWriter().field("ok", false).field("error", "tasks array required").done();
+  int added = 0;
+  for (auto& t : it->second.arr) {
+    if (done_.count(t) || leased_.count(t)) continue;
+    bool queued = false;
+    for (auto& q : todo_) if (q == t) { queued = true; break; }
+    if (!queued) { todo_.push_back(t); added++; }
+  }
+  return JsonWriter().field("ok", true).field("added", (double)added)
+      .field("queued", (double)todo_.size()).done();
+}
+
+std::string Coordinator::op_acquire_task(const JsonObject& req) {
+  std::string worker = get_str(req, "worker");
+  if (todo_.empty()) {
+    bool all_done = leased_.empty();
+    return JsonWriter().field("ok", true).field_null("task")
+        .field("exhausted", all_done).done();
+  }
+  std::string task = todo_.front();
+  todo_.pop_front();
+  leased_[task] = Lease{task, worker, now_sec() + task_lease_sec_};
+  return JsonWriter().field("ok", true).field("task", task)
+      .field("lease_sec", task_lease_sec_).done();
+}
+
+std::string Coordinator::op_complete_task(const JsonObject& req) {
+  std::string task = get_str(req, "task");
+  std::string worker = get_str(req, "worker");
+  auto it = leased_.find(task);
+  if (it == leased_.end())
+    return JsonWriter().field("ok", false).field("error", "not leased").done();
+  // A stale worker (lease expired, task re-leased elsewhere) must not be able
+  // to complete another worker's lease out from under it.
+  if (it->second.worker != worker)
+    return JsonWriter().field("ok", false).field("error", "lease not owned").done();
+  leased_.erase(it);
+  done_.insert(task);
+  return JsonWriter().field("ok", true).field("done", (double)done_.size())
+      .field("queued", (double)todo_.size()).done();
+}
+
+std::string Coordinator::op_fail_task(const JsonObject& req) {
+  std::string task = get_str(req, "task");
+  std::string worker = get_str(req, "worker");
+  auto it = leased_.find(task);
+  if (it == leased_.end())
+    return JsonWriter().field("ok", false).field("error", "not leased").done();
+  if (it->second.worker != worker)
+    return JsonWriter().field("ok", false).field("error", "lease not owned").done();
+  leased_.erase(it);
+  todo_.push_back(task);
+  return JsonWriter().field("ok", true).done();
+}
+
+std::string Coordinator::op_barrier(const JsonObject& req, int fd) {
+  std::string name = get_str(req, "name");
+  std::string worker = get_str(req, "worker");
+  int want = (int)get_num(req, "count", 0);
+  if (name.empty() || want <= 0)
+    return JsonWriter().field("ok", false).field("error", "name+count required").done();
+  Barrier& b = barriers_[name];
+  b.want = want;
+  b.arrived.insert(worker);
+  b.waiters.push_back(BarrierWaiter{fd, worker});
+  if ((int)b.arrived.size() >= b.want) {
+    std::string line = JsonWriter().field("ok", true).field("barrier", name)
+        .field("generation", (double)b.generation).done();
+    for (auto& waiter : b.waiters) deferred_.push_back({waiter.fd, line});
+    b.generation++;
+    b.arrived.clear();
+    b.waiters.clear();
+    return "";  // this fd's reply is in deferred_ too
+  }
+  return "";  // parked
+}
+
+std::string Coordinator::op_kv_put(const JsonObject& req) {
+  std::string key = get_str(req, "key");
+  if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
+  kv_[key] = get_str(req, "value");
+  return JsonWriter().field("ok", true).done();
+}
+
+std::string Coordinator::op_kv_get(const JsonObject& req) {
+  auto it = kv_.find(get_str(req, "key"));
+  JsonWriter w;
+  w.field("ok", true);
+  if (it == kv_.end()) w.field_null("value");
+  else w.field("value", it->second);
+  return w.done();
+}
+
+std::string Coordinator::op_kv_del(const JsonObject& req) {
+  kv_.erase(get_str(req, "key"));
+  return JsonWriter().field("ok", true).done();
+}
+
+std::string Coordinator::op_status() {
+  return JsonWriter()
+      .field("ok", true)
+      .field("epoch", (double)epoch_)
+      .field("world", (double)members_.size())
+      .field("queued", (double)todo_.size())
+      .field("leased", (double)leased_.size())
+      .field("done", (double)done_.size())
+      .done();
+}
+
+std::string Coordinator::handle(const JsonObject& req, int fd) {
+  std::string op = get_str(req, "op");
+  if (op == "register") return op_register(req);
+  if (op == "heartbeat") return op_heartbeat(req);
+  if (op == "leave") return op_leave(req);
+  if (op == "members") return op_members();
+  if (op == "add_tasks") return op_add_tasks(req);
+  if (op == "acquire_task") return op_acquire_task(req);
+  if (op == "complete_task") return op_complete_task(req);
+  if (op == "fail_task") return op_fail_task(req);
+  if (op == "barrier") return op_barrier(req, fd);
+  if (op == "kv_put") return op_kv_put(req);
+  if (op == "kv_get") return op_kv_get(req);
+  if (op == "kv_del") return op_kv_del(req);
+  if (op == "status") return op_status();
+  if (op == "ping") return JsonWriter().field("ok", true).field("pong", true).done();
+  return JsonWriter().field("ok", false).field("error", "unknown op: " + op).done();
+}
+
+void Coordinator::on_disconnect(int fd) {
+  // Withdraw the worker's pending barrier arrival along with its waiter
+  // entry: a crashed/disconnected worker must not count toward the barrier
+  // (matches the Python twin's timeout withdrawal) — otherwise survivors
+  // would pass a sync point the dead worker never completed.
+  for (auto& [_, b] : barriers_) {
+    auto& w = b.waiters;
+    for (size_t i = 0; i < w.size();) {
+      if (w[i].fd == fd) {
+        b.arrived.erase(w[i].worker);
+        w.erase(w.begin() + i);
+      } else {
+        i++;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// poll() server
+// ---------------------------------------------------------------------------
+
+}  // namespace
+
+int make_listener(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { perror("socket"); exit(1); }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(port);
+  if (bind(fd, (sockaddr*)&addr, sizeof addr) < 0) { perror("bind"); exit(1); }
+  if (listen(fd, 128) < 0) { perror("listen"); exit(1); }
+  fcntl(fd, F_SETFL, O_NONBLOCK);
+  return fd;
+}
+
+int main(int argc, char** argv) {
+  int port = 7164;
+  double task_lease = 16.0;   // ref: -task-timout-dur 16s (docker/paddle_k8s:30)
+  double hb_ttl = 10.0;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
+    if (a == "--port") port = atoi(next());
+    else if (a == "--task-lease-sec") task_lease = atof(next());
+    else if (a == "--heartbeat-ttl-sec") hb_ttl = atof(next());
+    else if (a == "--help") {
+      printf("edl-coordinator --port N [--task-lease-sec S] [--heartbeat-ttl-sec S]\n");
+      return 0;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int listener = make_listener(port);
+  fprintf(stderr, "edl-coordinator listening on 127.0.0.1:%d (task-lease %.1fs, hb-ttl %.1fs)\n",
+          port, task_lease, hb_ttl);
+  fflush(stderr);
+
+  Coordinator coord(task_lease, hb_ttl);
+  std::map<int, Conn> conns;
+
+  while (true) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({listener, POLLIN, 0});
+    for (auto& [fd, c] : conns) {
+      short ev = POLLIN;
+      if (!c.outbuf.empty()) ev |= POLLOUT;
+      pfds.push_back({fd, ev, 0});
+    }
+    double wait = coord.tick();
+    // Deliver any barrier releases produced by expiry before polling.
+    for (auto& [fd, line] : coord.take_deferred()) {
+      auto it = conns.find(fd);
+      if (it != conns.end()) it->second.outbuf += line;
+    }
+    poll(pfds.data(), pfds.size(), (int)(wait * 1000));
+
+    // Accept
+    if (pfds[0].revents & POLLIN) {
+      while (true) {
+        int cfd = accept(listener, nullptr, nullptr);
+        if (cfd < 0) break;
+        fcntl(cfd, F_SETFL, O_NONBLOCK);
+        int one = 1;
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        conns[cfd] = Conn{cfd, "", "", false};
+      }
+    }
+
+    std::vector<int> to_close;
+    for (size_t i = 1; i < pfds.size(); i++) {
+      int fd = pfds[i].fd;
+      auto it = conns.find(fd);
+      if (it == conns.end()) continue;
+      Conn& c = it->second;
+      if (pfds[i].revents & (POLLERR | POLLHUP)) {
+        to_close.push_back(fd);
+        continue;
+      }
+      if (pfds[i].revents & POLLIN) {
+        char buf[65536];
+        while (true) {
+          ssize_t n = read(fd, buf, sizeof buf);
+          if (n > 0) c.inbuf.append(buf, n);
+          else if (n == 0) { to_close.push_back(fd); break; }
+          else break;  // EAGAIN or error
+        }
+        size_t pos;
+        while ((pos = c.inbuf.find('\n')) != std::string::npos) {
+          std::string line = c.inbuf.substr(0, pos);
+          c.inbuf.erase(0, pos + 1);
+          if (line.empty()) continue;
+          JsonObject req;
+          JsonParser parser(line);
+          if (!parser.parse_object(&req)) {
+            c.outbuf += JsonWriter().field("ok", false).field("error", "bad json").done();
+            continue;
+          }
+          std::string resp = coord.handle(req, fd);
+          c.outbuf += resp;
+        }
+      }
+      if (pfds[i].revents & POLLOUT) {
+        // flushed below
+      }
+    }
+
+    // Barrier releases from this round of requests.
+    for (auto& [fd, line] : coord.take_deferred()) {
+      auto cit = conns.find(fd);
+      if (cit != conns.end()) cit->second.outbuf += line;
+    }
+
+    // Flush output buffers.
+    for (auto& [fd, c] : conns) {
+      while (!c.outbuf.empty()) {
+        ssize_t n = write(fd, c.outbuf.data(), c.outbuf.size());
+        if (n > 0) c.outbuf.erase(0, n);
+        else break;
+      }
+    }
+
+    for (int fd : to_close) {
+      coord.on_disconnect(fd);
+      close(fd);
+      conns.erase(fd);
+    }
+  }
+  return 0;
+}
