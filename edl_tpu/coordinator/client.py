@@ -135,9 +135,26 @@ class CoordinatorClient:
         """Block until ``count`` distinct workers arrive at ``name``.
 
         Replaces the launcher's sleep-and-poll barriers
-        (docker/paddle_k8s:128-130,178) with a real rendezvous.
+        (docker/paddle_k8s:128-130,178) with a real rendezvous. On timeout
+        returns {"ok": False, "error": "timeout"} (matching the in-process
+        twin) rather than raising; the connection is re-established.
         """
-        return self.call("barrier", timeout=timeout, name=name, count=count)
+        try:
+            return self.call("barrier", timeout=timeout, name=name, count=count)
+        except CoordinatorError:
+            return {"ok": False, "error": "timeout"}
+
+    def sync(self, epoch: int, timeout: float = 60.0) -> Dict:
+        """Epoch-synchronized rendezvous (the rescale sync point): blocks
+        until every current member arrives at ``epoch``. Replies:
+        {"ok": True} released; {"ok": False, "resync": True, epoch, world}
+        when membership moved (retry with the new epoch); {"ok": False,
+        "error": "timeout"} on client-side timeout.
+        """
+        try:
+            return self.call("sync", timeout=timeout, epoch=int(epoch))
+        except CoordinatorError:
+            return {"ok": False, "error": "timeout"}
 
     # -- KV (etcd-role subset) -------------------------------------------------
 

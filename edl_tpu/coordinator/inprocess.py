@@ -30,6 +30,8 @@ class InProcessCoordinator:
         self._leased: Dict[str, Dict] = {}  # task -> {worker, deadline}
         self._done: Set[str] = set()
         self._barriers: Dict[str, Dict] = {}  # name -> {arrived, generation}
+        self._sync_arrived: Set[str] = set()
+        self._sync_generation = 0
         self._kv: Dict[str, str] = {}
 
     # -- expiry ---------------------------------------------------------------
@@ -60,6 +62,12 @@ class InProcessCoordinator:
         for t in back:
             del self._leased[t]
             self._todo.append(t)
+        self._release_sync()
+
+    def _release_sync(self) -> None:
+        """Membership moved: wake parked sync waiters so they resync."""
+        self._sync_arrived = set()
+        self._barrier_cv.notify_all()
 
     def _membership_reply(self, worker: str) -> Dict:
         m = self._members.get(worker)
@@ -82,6 +90,7 @@ class InProcessCoordinator:
                 }
                 self._next_rank += 1
                 self._epoch += 1
+                self._release_sync()
             else:
                 self._members[worker]["last_heartbeat"] = time.monotonic()
             return self._membership_reply(worker)
@@ -181,6 +190,38 @@ class InProcessCoordinator:
                 self._barrier_cv.wait(remaining)
             return {"ok": True, "barrier": name, "generation": gen}
 
+    def sync(self, worker: str, epoch: int, timeout: float = 60.0) -> Dict:
+        """Epoch-synchronized rendezvous: released when every current member
+        arrives at ``epoch``; membership movement releases with resync=True."""
+        with self._barrier_cv:
+            self._tick()
+            if worker not in self._members:
+                return {"ok": False, "error": "unknown worker",
+                        "epoch": self._epoch, "world": len(self._members)}
+            self._members[worker]["last_heartbeat"] = time.monotonic()
+            if epoch != self._epoch:
+                return {"ok": False, "resync": True,
+                        "epoch": self._epoch, "world": len(self._members)}
+            self._sync_arrived.add(worker)
+            if self._sync_arrived >= set(self._members):
+                self._sync_generation += 1
+                self._sync_arrived = set()
+                self._barrier_cv.notify_all()
+                return {"ok": True, "epoch": self._epoch, "world": len(self._members)}
+            gen = self._sync_generation
+            deadline = time.monotonic() + timeout
+            while gen == self._sync_generation and epoch == self._epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._sync_arrived.discard(worker)
+                    return {"ok": False, "error": "timeout",
+                            "epoch": self._epoch, "world": len(self._members)}
+                self._barrier_cv.wait(remaining)
+            if epoch != self._epoch:
+                return {"ok": False, "resync": True,
+                        "epoch": self._epoch, "world": len(self._members)}
+            return {"ok": True, "epoch": self._epoch, "world": len(self._members)}
+
     def kv_put(self, key: str, value: str) -> None:
         with self._lock:
             self._kv[key] = value
@@ -262,6 +303,9 @@ class InProcessClient:
 
     def barrier(self, name, count, timeout=120.0):
         return self._c.barrier(self.worker, name, count, timeout)
+
+    def sync(self, epoch, timeout=60.0):
+        return self._c.sync(self.worker, epoch, timeout)
 
     def kv_put(self, key, value):
         return self._c.kv_put(key, value)

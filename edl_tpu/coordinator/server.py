@@ -22,9 +22,12 @@ BINARY = os.path.join(NATIVE_DIR, "edl-coordinator")
 
 
 def ensure_built(timeout: float = 120.0) -> str:
-    """Build the coordinator binary if missing; returns its path."""
-    if os.path.exists(BINARY):
-        return BINARY
+    """Build the coordinator binary; returns its path.
+
+    Always invokes make — it no-ops in milliseconds when the binary is fresh,
+    and rebuilds after source edits (a stale-binary check by existence alone
+    would silently keep old protocol semantics live).
+    """
     proc = subprocess.run(
         ["make", "-C", NATIVE_DIR],
         capture_output=True,

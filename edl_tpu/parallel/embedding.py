@@ -50,9 +50,19 @@ class ShardedEmbedding:
     batch_axis: str = "data"
     dtype: jnp.dtype = jnp.float32
 
+    #: vocab is padded to a multiple of this REGARDLESS of mesh size, so the
+    #: table shape is stable across elastic rescale (a checkpoint written on a
+    #: 4-shard mesh restores onto 8 shards by resharding, not reshaping).
+    #: 256 divides evenly for every power-of-two shard count up to 256.
+    PAD_MULTIPLE = 256
+
     def padded_vocab(self, mesh: Mesh) -> int:
         n = mesh.shape[self.shard_axis] if self.shard_axis in mesh.axis_names else 1
-        return _round_up(self.vocab_size, n)
+        if self.PAD_MULTIPLE % n == 0:
+            return _round_up(self.vocab_size, self.PAD_MULTIPLE)
+        # Exotic shard counts (e.g. 3, 12) fall back to the LCM so rows still
+        # split evenly — at the cost of rescale-compatible shapes.
+        return _round_up(self.vocab_size, n * self.PAD_MULTIPLE)
 
     def table_spec(self) -> P:
         return P(self.shard_axis, None)

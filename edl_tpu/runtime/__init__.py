@@ -7,5 +7,21 @@ orbax async checkpoints, and checkpoint-restore mesh rescale.
 """
 
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
+from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
+from edl_tpu.runtime.data import LeaseReader, SyntheticShardSource, shard_names
+from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
 
-__all__ = ["TrainState", "Trainer", "TrainerConfig"]
+__all__ = [
+    "Checkpointer",
+    "ElasticConfig",
+    "ElasticWorker",
+    "LeaseReader",
+    "RescaleEvent",
+    "SyntheticShardSource",
+    "TrainState",
+    "Trainer",
+    "TrainerConfig",
+    "abstract_like",
+    "live_state_specs",
+    "shard_names",
+]

@@ -1,0 +1,128 @@
+"""Async checkpoint/restore with cross-mesh resharding.
+
+The reference's durability story is trainer-side `save_inference_model` every
+N batches (trainer 0 only, `example/ctr/ctr/train.py:169-180`) plus the
+design assumption that pserver state survives trainer churn. On TPU there are
+no pservers: ALL state (params + optimizer moments, including row-sharded
+embedding tables) lives in the mesh, so elasticity = coordinated
+checkpoint-restore. This module wraps orbax:
+
+- saves are async (orbax's background thread) so the <30 s rescale budget is
+  not spent serializing HBM;
+- restore takes a TARGET mesh: each array is restored directly into its new
+  sharding (orbax reshards on load), which is what makes v5e-4 -> v5e-16
+  rescale a restore, not a reshape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+def live_state_specs(state: Any) -> Any:
+    """PartitionSpec pytree read off a live (already-placed) state: NamedSharding
+    leaves keep their spec; single-device/replicated leaves map to P()."""
+
+    def spec_of(x) -> PartitionSpec:
+        sh = getattr(x, "sharding", None)
+        return sh.spec if isinstance(sh, NamedSharding) else PartitionSpec()
+
+    return jax.tree_util.tree_map(spec_of, state)
+
+
+def abstract_like(state: Any) -> Any:
+    """ShapeDtypeStruct pytree matching ``state`` (no shardings attached)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+
+
+def state_shardings(abstract_state: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """NamedSharding pytree for ``abstract_state`` on ``mesh``.
+
+    ``spec_tree`` carries PartitionSpecs for leaves that are sharded (matching
+    params structure); leaves absent from it are replicated. The optimizer
+    state reuses param specs by structure-matching its inner param-like trees.
+    """
+
+    def to_sharding(spec) -> NamedSharding:
+        return NamedSharding(mesh, spec if spec is not None else PartitionSpec())
+
+    return jax.tree_util.tree_map(
+        lambda _, spec: to_sharding(spec), abstract_state, spec_tree
+    )
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper bound to one directory."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    def save(self, step: int, state: Any, extra: Optional[dict] = None) -> None:
+        """Async save; returns immediately (orbax serializes in background)."""
+        args = {"state": ocp.args.StandardSave(state)}
+        if extra is not None:
+            args["extra"] = ocp.args.JsonSave(extra)
+        self._mngr.save(step, args=ocp.args.Composite(**args))
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(
+        self,
+        abstract_state: Any,
+        mesh: Mesh,
+        spec_tree: Any,
+        step: Optional[int] = None,
+    ) -> Any:
+        """Restore into ``mesh`` with ``spec_tree`` shardings (reshard-on-load).
+
+        ``abstract_state`` is a ShapeDtypeStruct pytree (e.g. from
+        ``jax.eval_shape`` of the init path on the NEW mesh) — shapes must
+        match what was saved; shardings may differ freely.
+        """
+        step = step if step is not None else self._mngr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        shardings = state_shardings(abstract_state, mesh, spec_tree)
+        target = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract_state,
+            shardings,
+        )
+        restored = self._mngr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore(target))
+        )
+        return restored["state"]
+
+    def restore_extra(self, step: Optional[int] = None) -> Optional[dict]:
+        step = step if step is not None else self._mngr.latest_step()
+        if step is None:
+            return None
+        try:
+            out = self._mngr.restore(
+                step, args=ocp.args.Composite(extra=ocp.args.JsonRestore())
+            )
+            return out.get("extra")
+        except Exception:
+            return None
+
+    def close(self) -> None:
+        self._mngr.close()

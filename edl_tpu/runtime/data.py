@@ -1,0 +1,84 @@
+"""Lease-driven data pipeline: the cloud_reader equivalent.
+
+The reference's fault-tolerant trainers pull chunked tasks from the master's
+etcd-backed queue (`cloud_reader(etcd_endpoint)`,
+`example/fit_a_line/train_ft.py:111-114`); non-FT trainers statically split
+files by rank (`example/fit_a_line/fluid/common.py:24-40`). Here a shard is a
+coordinator lease: trainers acquire, produce that shard's batches, complete.
+At-least-once: a shard leased by a departed/stalled trainer requeues, and
+replays are deterministic (batches derive from the shard id).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from edl_tpu.models.base import Model
+
+
+def shard_names(prefix: str, count: int) -> List[str]:
+    """Canonical shard-id scheme: '<prefix>/part-00000'..."""
+    return [f"{prefix}/part-{i:05d}" for i in range(count)]
+
+
+def _shard_seed(shard: str) -> int:
+    return int.from_bytes(hashlib.sha256(shard.encode()).digest()[:8], "little")
+
+
+@dataclass
+class SyntheticShardSource:
+    """Deterministic batches for a shard id: replaying a requeued lease yields
+    bit-identical data, so elastic replays do not skew training distribution."""
+
+    model: Model
+    batch_size: int
+    batches_per_shard: int
+
+    def read(self, shard: str) -> Iterator[Dict[str, np.ndarray]]:
+        rng = np.random.default_rng(_shard_seed(shard))
+        for _ in range(self.batches_per_shard):
+            yield self.model.synthetic_batch(rng, self.batch_size)
+
+
+class LeaseReader:
+    """Iterate (shard, batch) pairs by leasing shards from the coordinator.
+
+    ``stop_check`` is polled between batches — the elastic worker passes its
+    epoch-change detector so a rescale interrupts mid-shard, failing the lease
+    back to the queue for replay on the new mesh.
+    """
+
+    def __init__(
+        self,
+        client,  # CoordinatorClient | InProcessClient
+        source,  # object with .read(shard) -> Iterator[batch]
+        stop_check: Optional[Callable[[], bool]] = None,
+    ):
+        self.client = client
+        self.source = source
+        self.stop_check = stop_check or (lambda: False)
+        self.completed: List[str] = []
+        self.interrupted: Optional[str] = None
+        self.exhausted = False
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            reply = self.client.acquire()
+            task = reply.get("task")
+            if task is None:
+                self.exhausted = bool(reply.get("exhausted"))
+                return
+            for batch in self.source.read(task):
+                if self.stop_check():
+                    # Rescale signal mid-shard: give the lease back for a
+                    # deterministic replay on the new mesh.
+                    self.client.fail_task(task)
+                    self.interrupted = task
+                    return
+                yield batch
+            self.client.complete_task(task)
+            self.completed.append(task)

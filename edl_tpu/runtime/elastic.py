@@ -1,0 +1,265 @@
+"""Elastic training: membership-driven checkpoint-restore mesh rescale.
+
+The reference's elasticity: the autoscaler rewrites trainer Parallelism
+(`pkg/autoscaler.go:361-362`), K8s adds/removes trainer pods, and correctness
+rests on pserver-held state + the master task queue
+(`pkg/resource/training_job.go:39-58`). On TPU all state is in the mesh, so
+the flow becomes:
+
+  register -> build mesh for current world -> restore-or-init ->
+  train on leased shards, heartbeating ->
+  on membership epoch change: checkpoint (async), barrier with survivors,
+  rebuild mesh at the new world size, restore (reshard-on-load), resume.
+
+Recovery time (detect -> first step on the new mesh) is measured and reported
+— the north-star budget is <30 s (BASELINE.md).
+
+``device_planner`` maps a world size to the devices this process should put
+in the mesh. In production multi-host mode every process contributes its
+local chips and the planner is trivial; in single-host tests/simulation it
+slices the virtual CPU devices so world=1 -> 4 devices, world=2 -> 8 devices,
+mimicking trainers joining a slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from edl_tpu.models.base import Model
+from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
+from edl_tpu.runtime.data import LeaseReader
+from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
+
+log = logging.getLogger("edl_tpu.elastic")
+
+
+@dataclass
+class ElasticConfig:
+    checkpoint_dir: str = ""
+    checkpoint_interval: int = 100  # steps between periodic async saves
+    heartbeat_interval: float = 1.0  # seconds between coordinator heartbeats
+    #: max wait for survivors at the rescale barrier; on timeout we proceed
+    #: (the checkpoint is already durable, latecomers restore from it).
+    rescale_barrier_timeout: float = 60.0
+    batch_axis: str = "data"
+    trainer: TrainerConfig = field(default_factory=TrainerConfig)
+
+
+def default_device_planner(chips_per_trainer: int) -> Callable[[int], Sequence[jax.Device]]:
+    """world -> first world*chips local devices (single-host simulation)."""
+
+    def plan(world: int) -> Sequence[jax.Device]:
+        devs = jax.devices()
+        want = max(1, world * chips_per_trainer)
+        if want > len(devs):
+            want = len(devs)
+        return devs[:want]
+
+    return plan
+
+
+@dataclass
+class RescaleEvent:
+    at_step: int
+    from_world: int
+    to_world: int
+    recovery_seconds: float
+
+
+class ElasticWorker:
+    """One trainer process's elastic loop."""
+
+    def __init__(
+        self,
+        model: Model,
+        client,  # coordinator client bound to this worker's name
+        source,  # shard source with .read(shard)
+        config: ElasticConfig,
+        device_planner: Optional[Callable[[int], Sequence[jax.Device]]] = None,
+        mesh_axes: Optional[Dict[str, int]] = None,
+    ):
+        if not config.checkpoint_dir:
+            raise ValueError("ElasticConfig.checkpoint_dir is required")
+        self.model = model
+        self.client = client
+        self.source = source
+        self.config = config
+        self.planner = device_planner or default_device_planner(4)
+        self.mesh_axes = mesh_axes  # extra non-data axes, sized per full mesh
+        self.ckpt = Checkpointer(config.checkpoint_dir)
+        self.rescales: List[RescaleEvent] = []
+        self.steps_done = 0
+        self.losses: List[float] = []
+        self._epoch = -1
+        self._world = 0
+        self._prev_world = 0
+        self._last_heartbeat = 0.0
+
+    # -- membership ------------------------------------------------------------
+
+    def _sync_membership(self) -> None:
+        info = self.client.register()
+        self._epoch = info["epoch"]
+        self._world = max(1, info["world"])
+
+    def _epoch_changed(self, force: bool = False) -> bool:
+        """Heartbeat (rate-limited) and report whether membership moved."""
+        now = time.monotonic()
+        if not force and now - self._last_heartbeat < self.config.heartbeat_interval:
+            return False
+        self._last_heartbeat = now
+        reply = self.client.heartbeat()
+        if not reply.get("ok"):
+            # We were expired (e.g. long compile stall): rejoin.
+            reply = self.client.register()
+        return reply["epoch"] != self._epoch
+
+    def _rendezvous(self) -> None:
+        """Agree on (epoch, world) with every live member before building the
+        mesh. The coordinator releases the sync when all current members have
+        arrived at the same epoch; if membership moves mid-wait we get
+        resync=True with the new epoch and retry. On timeout we proceed —
+        the checkpoint is already durable and stragglers restore from it.
+        """
+        for _ in range(64):
+            reply = self.client.sync(
+                self._epoch, timeout=self.config.rescale_barrier_timeout
+            )
+            if reply.get("ok"):
+                self._world = max(1, reply["world"])
+                return
+            if reply.get("resync"):
+                self._epoch = reply["epoch"]
+                self._world = max(1, reply["world"])
+                continue
+            if reply.get("error") == "unknown worker":
+                info = self.client.register()
+                self._epoch = info["epoch"]
+                self._world = max(1, info["world"])
+                continue
+            log.warning("rescale sync incomplete (%s); proceeding", reply)
+            return
+        raise RuntimeError("rendezvous thrashed: membership never settled")
+
+    # -- mesh / state ----------------------------------------------------------
+
+    def _build_mesh(self, world: int) -> Mesh:
+        devices = list(self.planner(world))
+        axes = dict(self.mesh_axes or {})
+        n = len(devices)
+        fixed = 1
+        for size in axes.values():
+            fixed *= size
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        axes["data"] = n // fixed
+        return build_mesh(MeshSpec(axes), devices)
+
+    def _restore_or_init(self, trainer: Trainer) -> TrainState:
+        fresh = trainer.init_state()
+        if self.ckpt.latest_step() is None:
+            return fresh
+        state = self.ckpt.restore(
+            abstract_like(fresh), trainer.mesh, live_state_specs(fresh)
+        )
+        log.info("restored checkpoint step=%s onto %d-device mesh",
+                 self.ckpt.latest_step(), trainer.mesh.size)
+        return state
+
+    def _checkpoint(self, state: TrainState, block: bool = False) -> None:
+        self.ckpt.save(int(state.step), state)
+        if block:
+            self.ckpt.wait()
+
+    # -- main loop -------------------------------------------------------------
+
+    def run(self, max_rescales: int = 32) -> Dict[str, float]:
+        """Train until the task queue is exhausted, rescaling on membership
+        changes. Returns summary metrics."""
+        self._sync_membership()
+        t_start = time.perf_counter()
+        while True:
+            # Rendezvous: all members agree on (epoch, world) before meshes
+            # are built — joiners arrive here too, so nobody waits on a ghost.
+            self._rendezvous()
+            world = self._world
+            rescale_t0 = time.perf_counter()
+            mesh = self._build_mesh(world)
+            trainer = Trainer(self.model, mesh, self.config.trainer)
+            state = self._restore_or_init(trainer)
+            first_step_done = False
+            last_ckpt_step = int(state.step)
+            rescale = False
+            finished = False
+
+            while not rescale and not finished:
+                reader = LeaseReader(
+                    self.client, self.source, stop_check=self._epoch_changed
+                )
+                for batch in reader:
+                    placed = trainer.place_batch(batch)
+                    state, loss = trainer.train_step(state, placed)
+                    if not first_step_done:
+                        first_step_done = True
+                        recovery = time.perf_counter() - rescale_t0
+                        if self.steps_done:  # a rescale, not cold start
+                            self.rescales.append(
+                                RescaleEvent(
+                                    at_step=int(state.step),
+                                    from_world=self._prev_world,
+                                    to_world=world,
+                                    recovery_seconds=recovery,
+                                )
+                            )
+                    self.steps_done += 1
+                    self.losses.append(float(loss))
+                    step = int(state.step)
+                    if step - last_ckpt_step >= self.config.checkpoint_interval:
+                        self._checkpoint(state)
+                        last_ckpt_step = step
+
+                if reader.interrupted is not None:
+                    rescale = True
+                elif reader.exhausted:
+                    finished = True
+                else:
+                    # Queue empty but leases outstanding elsewhere: a peer may
+                    # still fail and requeue its shard, so keep polling until
+                    # the queue truly drains (or membership changes).
+                    time.sleep(0.2)
+                    if self._epoch_changed(force=True):
+                        rescale = True
+
+            if rescale:
+                # Membership changed: make state durable, then rendezvous at
+                # the top of the loop and rebuild at the agreed world size.
+                self._checkpoint(state, block=True)
+                self._prev_world = world
+                info = self.client.register()  # refresh observed epoch/world
+                self._epoch = info["epoch"]
+                self._world = max(1, info["world"])
+                if len(self.rescales) >= max_rescales:
+                    raise RuntimeError("too many rescales; aborting")
+                continue
+
+            # Queue exhausted: final checkpoint and finish.
+            self._checkpoint(state, block=True)
+            total = time.perf_counter() - t_start
+            return {
+                "steps": float(self.steps_done),
+                "final_loss": self.losses[-1] if self.losses else float("nan"),
+                "world": float(self._world),
+                "rescales": float(len(self.rescales)),
+                "max_recovery_seconds": max(
+                    (r.recovery_seconds for r in self.rescales), default=0.0
+                ),
+                "seconds": total,
+            }

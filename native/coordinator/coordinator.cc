@@ -286,7 +286,6 @@ struct Conn {
   int fd = -1;
   std::string inbuf;
   std::string outbuf;
-  bool closing = false;
 };
 
 class Coordinator {
@@ -320,12 +319,16 @@ class Coordinator {
   std::string op_complete_task(const JsonObject& req);
   std::string op_fail_task(const JsonObject& req);
   std::string op_barrier(const JsonObject& req, int fd);
+  std::string op_sync(const JsonObject& req, int fd);
   std::string op_kv_put(const JsonObject& req);
   std::string op_kv_get(const JsonObject& req);
   std::string op_kv_del(const JsonObject& req);
   std::string op_status();
 
   void bump_epoch() { epoch_++; }
+  // Release all parked sync waiters: ok=true when the epoch rendezvous
+  // completed, ok=false (resync) when membership moved underneath them.
+  void release_sync(bool ok);
   void drop_member(const std::string& name);
   void requeue_expired_leases(double now);
   std::string membership_reply(const std::string& worker, bool ok_rank);
@@ -345,12 +348,31 @@ class Coordinator {
   int next_rank_ = 0;
   std::map<std::string, Member> members_;
   std::deque<std::string> todo_;
+  std::set<std::string> todo_set_;  // mirrors todo_ for O(log n) dedup
   std::map<std::string, Lease> leased_;   // task -> lease
   std::set<std::string> done_;
   std::map<std::string, Barrier> barriers_;
+  // Epoch-synchronized rendezvous (the rescale sync point): workers call
+  // op_sync with the epoch they observed; released when every current
+  // member has arrived at that epoch, or with resync when the epoch moves.
+  std::set<std::string> sync_arrived_;
+  std::vector<BarrierWaiter> sync_waiters_;
   std::map<std::string, std::string> kv_;
   std::vector<std::pair<int, std::string>> deferred_;
 };
+
+void Coordinator::release_sync(bool ok) {
+  if (sync_waiters_.empty() && sync_arrived_.empty()) return;
+  JsonWriter w;
+  w.field("ok", ok);
+  if (!ok) w.field("resync", true);
+  w.field("epoch", (double)epoch_);
+  w.field("world", (double)members_.size());
+  std::string line = w.done();
+  for (auto& waiter : sync_waiters_) deferred_.push_back({waiter.fd, line});
+  sync_waiters_.clear();
+  sync_arrived_.clear();
+}
 
 void Coordinator::drop_member(const std::string& name) {
   if (members_.erase(name)) {
@@ -371,7 +393,9 @@ void Coordinator::drop_member(const std::string& name) {
     for (auto& t : back) {
       leased_.erase(t);
       todo_.push_back(t);
+      todo_set_.insert(t);
     }
+    release_sync(false);
   }
 }
 
@@ -382,6 +406,7 @@ void Coordinator::requeue_expired_leases(double now) {
   for (auto& t : back) {
     leased_.erase(t);
     todo_.push_back(t);
+    todo_set_.insert(t);
   }
 }
 
@@ -418,6 +443,7 @@ std::string Coordinator::op_register(const JsonObject& req) {
   if (it == members_.end()) {
     members_[worker] = Member{next_rank_++, now_sec()};
     bump_epoch();
+    release_sync(false);
   } else {
     it->second.last_heartbeat = now_sec();  // re-register == refresh
   }
@@ -455,10 +481,10 @@ std::string Coordinator::op_add_tasks(const JsonObject& req) {
     return JsonWriter().field("ok", false).field("error", "tasks array required").done();
   int added = 0;
   for (auto& t : it->second.arr) {
-    if (done_.count(t) || leased_.count(t)) continue;
-    bool queued = false;
-    for (auto& q : todo_) if (q == t) { queued = true; break; }
-    if (!queued) { todo_.push_back(t); added++; }
+    if (done_.count(t) || leased_.count(t) || todo_set_.count(t)) continue;
+    todo_.push_back(t);
+    todo_set_.insert(t);
+    added++;
   }
   return JsonWriter().field("ok", true).field("added", (double)added)
       .field("queued", (double)todo_.size()).done();
@@ -473,6 +499,7 @@ std::string Coordinator::op_acquire_task(const JsonObject& req) {
   }
   std::string task = todo_.front();
   todo_.pop_front();
+  todo_set_.erase(task);
   leased_[task] = Lease{task, worker, now_sec() + task_lease_sec_};
   return JsonWriter().field("ok", true).field("task", task)
       .field("lease_sec", task_lease_sec_).done();
@@ -504,6 +531,7 @@ std::string Coordinator::op_fail_task(const JsonObject& req) {
     return JsonWriter().field("ok", false).field("error", "lease not owned").done();
   leased_.erase(it);
   todo_.push_back(task);
+  todo_set_.insert(task);
   return JsonWriter().field("ok", true).done();
 }
 
@@ -527,6 +555,26 @@ std::string Coordinator::op_barrier(const JsonObject& req, int fd) {
     return "";  // this fd's reply is in deferred_ too
   }
   return "";  // parked
+}
+
+std::string Coordinator::op_sync(const JsonObject& req, int fd) {
+  std::string worker = get_str(req, "worker");
+  long long epoch = (long long)get_num(req, "epoch", -1);
+  auto it = members_.find(worker);
+  if (it == members_.end())
+    return JsonWriter().field("ok", false).field("error", "unknown worker")
+        .field("epoch", (double)epoch_).field("world", (double)members_.size()).done();
+  it->second.last_heartbeat = now_sec();  // arrival refreshes the TTL
+  if (epoch != epoch_)
+    return JsonWriter().field("ok", false).field("resync", true)
+        .field("epoch", (double)epoch_).field("world", (double)members_.size()).done();
+  sync_arrived_.insert(worker);
+  sync_waiters_.push_back(BarrierWaiter{fd, worker});
+  bool all = true;
+  for (auto& [name, m] : members_)
+    if (!sync_arrived_.count(name)) { all = false; break; }
+  if (all) release_sync(true);
+  return "";  // reply delivered via deferred_ when released
 }
 
 std::string Coordinator::op_kv_put(const JsonObject& req) {
@@ -572,6 +620,7 @@ std::string Coordinator::handle(const JsonObject& req, int fd) {
   if (op == "complete_task") return op_complete_task(req);
   if (op == "fail_task") return op_fail_task(req);
   if (op == "barrier") return op_barrier(req, fd);
+  if (op == "sync") return op_sync(req, fd);
   if (op == "kv_put") return op_kv_put(req);
   if (op == "kv_get") return op_kv_get(req);
   if (op == "kv_del") return op_kv_del(req);
@@ -594,6 +643,14 @@ void Coordinator::on_disconnect(int fd) {
       } else {
         i++;
       }
+    }
+  }
+  for (size_t i = 0; i < sync_waiters_.size();) {
+    if (sync_waiters_[i].fd == fd) {
+      sync_arrived_.erase(sync_waiters_[i].worker);
+      sync_waiters_.erase(sync_waiters_.begin() + i);
+    } else {
+      i++;
     }
   }
 }
@@ -653,7 +710,8 @@ int main(int argc, char** argv) {
       pfds.push_back({fd, ev, 0});
     }
     double wait = coord.tick();
-    // Deliver any barrier releases produced by expiry before polling.
+    // Heartbeat expiry inside tick() can release sync waiters (resync):
+    // deliver those before blocking in poll.
     for (auto& [fd, line] : coord.take_deferred()) {
       auto it = conns.find(fd);
       if (it != conns.end()) it->second.outbuf += line;
@@ -668,7 +726,7 @@ int main(int argc, char** argv) {
         fcntl(cfd, F_SETFL, O_NONBLOCK);
         int one = 1;
         setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-        conns[cfd] = Conn{cfd, "", "", false};
+        conns[cfd] = Conn{cfd, "", ""};
       }
     }
 
@@ -705,12 +763,9 @@ int main(int argc, char** argv) {
           c.outbuf += resp;
         }
       }
-      if (pfds[i].revents & POLLOUT) {
-        // flushed below
-      }
     }
 
-    // Barrier releases from this round of requests.
+    // Barrier/sync releases from this round of requests.
     for (auto& [fd, line] : coord.take_deferred()) {
       auto cit = conns.find(fd);
       if (cit != conns.end()) cit->second.outbuf += line;

@@ -205,3 +205,57 @@ def test_kv_non_ascii_and_control_chars_roundtrip(coord):
     assert c.kv_get("path") == "café/中文"
     c.kv_put("ctl", "a\x01b\x0bc")
     assert c.kv_get("ctl") == "a\x01b\x0bc"
+
+
+def test_sync_rendezvous_all_members(coord):
+    """Epoch sync: released only when every member arrives; a joiner mid-wait
+    forces resync with the new epoch."""
+    a = coord.client("sy-a")
+    b = coord.client("sy-b")
+    ea = a.register()["epoch"]
+    eb = b.register()["epoch"]
+    results = {}
+
+    def arrive(name, cli, epoch):
+        results[name] = cli.sync(epoch, timeout=10.0)
+
+    # a syncs at its stale epoch -> immediate resync reply
+    stale = a.sync(ea, timeout=5.0)
+    assert stale["ok"] is False and stale.get("resync") is True
+    assert stale["epoch"] == eb
+
+    ta = threading.Thread(target=arrive, args=("a", a, eb))
+    tb = threading.Thread(target=arrive, args=("b", b, eb))
+    ta.start()
+    time.sleep(0.2)
+    tb.start()
+    ta.join(timeout=15)
+    tb.join(timeout=15)
+    assert results["a"]["ok"] and results["b"]["ok"], results
+    assert results["a"]["world"] == 2
+    a.leave()
+    b.leave()
+
+
+def test_sync_released_with_resync_on_join(coord):
+    """A parked sync waiter is woken with resync when membership moves."""
+    a = coord.client("syj-a")
+    b = coord.client("syj-b")
+    a.register()
+    epoch = b.register()["epoch"]
+    result = {}
+
+    def waiter():
+        # a parks: b never arrives at this epoch
+        result["r"] = a.sync(epoch, timeout=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    c = coord.client("syj-c")  # join bumps the epoch -> waiter gets resync
+    c.register()
+    t.join(timeout=15)
+    r = result["r"]
+    assert r["ok"] is False and r.get("resync") is True and r["world"] == 3
+    for cli in (a, b, c):
+        cli.leave()

@@ -47,7 +47,7 @@ def test_sharded_embedding_same_axis_matches_dense():
     mesh = local_mesh()  # data: 8
     emb = ShardedEmbedding(vocab_size=64, features=16, shard_axis="data", batch_axis="data")
     table = emb.init(jax.random.PRNGKey(0), mesh)
-    assert table.shape == (64, 16)
+    assert table.shape == (256, 16)  # padded to the rescale-stable multiple
     ids = jnp.arange(32) * 2 % 64
     ids = jax.device_put(ids, jax.sharding.NamedSharding(mesh, P("data")))
     out = jax.jit(lambda t, i: emb.apply(mesh, t, i))(table, ids)
@@ -60,7 +60,7 @@ def test_sharded_embedding_cross_axis_matches_dense():
     mesh = build_mesh(MeshSpec({"data": 2, "expert": 4}))
     emb = ShardedEmbedding(vocab_size=100, features=8, shard_axis="expert", batch_axis="data")
     table = emb.init(jax.random.PRNGKey(1), mesh)
-    assert table.shape == (100, 8)  # padded to 100 (already divisible by 4)
+    assert table.shape == (256, 8)  # padded to the rescale-stable multiple
     ids = jnp.array([[0, 5, 99], [17, 42, 63]] * 4, dtype=jnp.int32)  # (8, 3)
     out = jax.jit(lambda t, i: emb.apply(mesh, t, i))(table, ids)
     assert out.shape == (8, 3, 8)
@@ -88,4 +88,4 @@ def test_sharded_embedding_vocab_padding():
     mesh = local_mesh()  # 8 shards
     emb = ShardedEmbedding(vocab_size=30, features=4)
     table = emb.init(jax.random.PRNGKey(3), mesh)
-    assert table.shape == (32, 4)  # padded to multiple of 8
+    assert table.shape == (256, 4)  # padded to the rescale-stable multiple
