@@ -1,0 +1,7 @@
+"""``python -m edl_tpu`` — the framework CLI (ref: cmd/edl/edl.go:16-51)."""
+
+import sys
+
+from edl_tpu.cli import main
+
+sys.exit(main())

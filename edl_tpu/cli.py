@@ -1,0 +1,202 @@
+"""The ``edl-tpu`` command-line interface.
+
+Equivalent of the reference's CLI entrypoint (`cmd/edl/edl.go:16-51`) plus the
+kubectl-side workflow its docs walk through (`doc/usage.md:81-118`):
+
+- ``controller`` — run the control plane (flags mirror `edl.go:17-20`:
+  ``--log-level``, ``--max-load-desired``).
+- ``validate``  — admission-check a TrainingJob YAML.
+- ``run``       — submit a YAML to an in-process control plane and follow it
+  to a terminal phase (the `kubectl create -f && watch` loop, hermetic).
+- ``train``     — run a model from the zoo locally on the live JAX backend
+  (the `train_local.py` twin, `example/fit_a_line/train_local.py:41-109`).
+
+Without a Kubernetes API the ``controller``/``run`` commands drive the
+in-memory FakeCluster provider — the hermetic twin the tests use; a real
+cluster provider plugs in behind the same ClusterProvider protocol.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+from typing import List, Optional
+
+from edl_tpu.api.types import TrainingJob
+from edl_tpu.api.validation import ValidationError, normalize
+
+
+def _add_nodes_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--hosts", type=int, default=4, help="fake-cluster host count")
+    p.add_argument("--chips-per-host", type=int, default=4, help="TPU chips per host")
+    p.add_argument("--cpu-per-host", type=float, default=16.0)
+    p.add_argument("--memory-per-host", default="64Gi")
+
+
+def _make_fake_cluster(args):
+    from edl_tpu.api.quantity import ResourceList
+    from edl_tpu.controller.cluster import FakeCluster, NodeInfo
+
+    nodes = [
+        NodeInfo(
+            name=f"host{i}",
+            allocatable=ResourceList.make(
+                {
+                    "cpu": args.cpu_per_host,
+                    "memory": args.memory_per_host,
+                    "tpu": args.chips_per_host,
+                }
+            ),
+        )
+        for i in range(args.hosts)
+    ]
+    return FakeCluster(nodes)
+
+
+def _load_job(path: str) -> TrainingJob:
+    with open(path) as f:
+        return TrainingJob.from_yaml(f.read())
+
+
+# -- subcommands ---------------------------------------------------------------
+
+
+def cmd_validate(args) -> int:
+    try:
+        job = normalize(_load_job(args.file))
+    except (ValidationError, ValueError, KeyError) as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(job.to_dict(), indent=2))
+    return 0
+
+
+def cmd_run(args) -> int:
+    from edl_tpu.controller import Controller
+    from edl_tpu.tools.collector import Collector
+
+    cluster = _make_fake_cluster(args)
+    controller = Controller(cluster, max_load_desired=args.max_load_desired)
+    controller.start()
+    collector = Collector(controller.store, cluster,
+                          period_seconds=args.collect_period, sink=sys.stderr)
+    collector.start()
+    try:
+        job = controller.submit(_load_job(args.file))
+        deadline = time.monotonic() + args.timeout
+        while time.monotonic() < deadline:
+            status = controller.job_status(job.name, job.namespace).status
+            if status.phase.terminal():
+                break
+            time.sleep(0.5)
+        final = controller.job_status(job.name, job.namespace)
+        print(json.dumps(final.to_dict()["status"], indent=2))
+        return 0 if final.status.phase.value == "Succeeded" else 2
+    finally:
+        collector.stop()
+        controller.stop()
+
+
+def cmd_controller(args) -> int:
+    from edl_tpu.controller import Controller
+    from edl_tpu.tools.collector import Collector
+
+    cluster = _make_fake_cluster(args)
+    controller = Controller(cluster, max_load_desired=args.max_load_desired)
+    controller.start()
+    collector = Collector(controller.store, cluster,
+                          period_seconds=args.collect_period, sink=sys.stdout)
+    collector.start()
+    logging.getLogger("edl_tpu").info("controller running; Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        collector.stop()
+        controller.stop()
+
+
+def cmd_train(args) -> int:
+    import numpy as np
+
+    import jax
+
+    from edl_tpu import models as model_zoo
+    from edl_tpu.parallel import MeshSpec, build_mesh
+    from edl_tpu.runtime import Trainer, TrainerConfig
+
+    model = model_zoo.get(args.model)
+    devices = jax.devices()
+    mesh = build_mesh(MeshSpec({"data": len(devices)}), devices)
+    trainer = Trainer(
+        model, mesh, TrainerConfig(optimizer=args.optimizer, learning_rate=args.lr)
+    )
+    state = trainer.init_state()
+    rng = np.random.default_rng(args.seed)
+
+    def batches():
+        for _ in range(args.steps):
+            yield model.synthetic_batch(rng, args.batch_size)
+
+    state, metrics = trainer.run(state, batches())
+    print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="edl-tpu",
+                                     description="TPU-native elastic training framework")
+    parser.add_argument("--log-level", default="info",
+                        choices=["debug", "info", "warning", "error"])
+    # Accept --log-level on either side of the subcommand (deploy manifests
+    # put flags after it, k8s-style). SUPPRESS keeps the child from
+    # overwriting a value parsed by the root.
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--log-level", default=argparse.SUPPRESS,
+                        choices=["debug", "info", "warning", "error"])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("validate", help="admission-check a TrainingJob YAML",
+                       parents=[common])
+    p.add_argument("-f", "--file", required=True)
+    p.set_defaults(fn=cmd_validate)
+
+    p = sub.add_parser("run", help="run a TrainingJob on an in-process control plane",
+                       parents=[common])
+    p.add_argument("-f", "--file", required=True)
+    p.add_argument("--max-load-desired", type=float, default=0.97)
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--collect-period", type=float, default=10.0)
+    _add_nodes_flags(p)
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("controller", help="run the control plane", parents=[common])
+    p.add_argument("--max-load-desired", type=float, default=0.97)
+    p.add_argument("--collect-period", type=float, default=10.0)
+    _add_nodes_flags(p)
+    p.set_defaults(fn=cmd_controller)
+
+    p = sub.add_parser("train", help="train a zoo model locally", parents=[common])
+    p.add_argument("--model", default="fit_a_line")
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--optimizer", default="adam")
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=cmd_train)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,18 +17,47 @@ from edl_tpu.controller.autoscaler import (
     scale_dry_run,
     sorted_jobs_by_fulfillment,
 )
+from edl_tpu.controller.controller import Controller
+from edl_tpu.controller.jobparser import (
+    ROLE_COORDINATOR,
+    ROLE_TRAINER,
+    RoleWorkload,
+    coordinator_endpoint,
+    make_env,
+    parse_job,
+    parse_to_coordinator,
+    parse_to_trainer,
+    role_labels,
+)
+from edl_tpu.controller.store import FuncWatcher, JobStore, Watcher
+from edl_tpu.controller.updater import JobUpdater, UpdaterConfig
 
 __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
     "ClusterProvider",
     "ClusterResource",
+    "Controller",
     "FakeCluster",
+    "FuncWatcher",
     "JobState",
+    "JobStore",
+    "JobUpdater",
     "NodeInfo",
     "PodInfo",
+    "ROLE_COORDINATOR",
+    "ROLE_TRAINER",
+    "RoleWorkload",
+    "UpdaterConfig",
+    "Watcher",
+    "coordinator_endpoint",
     "fulfillment",
+    "make_env",
     "make_room_dry_run",
+    "parse_job",
+    "parse_to_coordinator",
+    "parse_to_trainer",
+    "role_labels",
     "scale_all_dry_run",
     "scale_dry_run",
     "sorted_jobs_by_fulfillment",

@@ -28,7 +28,7 @@ import queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from edl_tpu.api.quantity import ResourceList
 from edl_tpu.api.types import ScaleRecord, TrainingJob
@@ -273,6 +273,9 @@ class Autoscaler:
         self._thread: Optional[threading.Thread] = None
         #: most recent plan, for observability/collector (job -> target).
         self.last_plan: Dict[str, int] = {}
+        #: optional actuation listener (job_name, ScaleRecord) — the controller
+        #: routes these to the job's updater, the sole status writer.
+        self.on_scaled: Optional[Callable[[str, ScaleRecord], None]] = None
 
     # -- informer-style callbacks (ref: autoscaler.go:158-171) -----------------
 
@@ -317,7 +320,12 @@ class Autoscaler:
     # -- one scaling pass (ref: autoscaler.go:461-485) -------------------------
 
     def step(self) -> Dict[str, int]:
-        elastic = [s for s in self.jobs.values() if s.job.elastic()]
+        # Terminal jobs keep their JobState for history but are never scaled
+        # (the reference releases completed jobs from the scaler via OnDel).
+        elastic = [
+            s for s in self.jobs.values()
+            if s.job.elastic() and not s.job.status.phase.terminal()
+        ]
         if not elastic:
             return {}
         for s in elastic:
@@ -372,17 +380,18 @@ class Autoscaler:
                 try:
                     before = self.cluster.get_trainer_parallelism(name)
                     self.cluster.set_trainer_parallelism(name, parallelism)
+                    record = ScaleRecord(
+                        timestamp=time.time(),
+                        from_replicas=before,
+                        to_replicas=parallelism,
+                        reason=reason,
+                    )
                     if state is not None:
                         state.current = parallelism
                         state.job.status.parallelism = parallelism
-                        state.job.status.scale_history.append(
-                            ScaleRecord(
-                                timestamp=time.time(),
-                                from_replicas=before,
-                                to_replicas=parallelism,
-                                reason=reason,
-                            )
-                        )
+                        state.job.status.scale_history.append(record)
+                    if self.on_scaled is not None:
+                        self.on_scaled(name, record)
                     break
                 except KeyError:
                     log.info("job %s vanished before actuation; dropping", name)

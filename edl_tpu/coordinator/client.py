@@ -167,6 +167,13 @@ class CoordinatorClient:
     def kv_del(self, key: str) -> None:
         self.call("kv_del", key=key)
 
+    def kv_incr(self, key: str, delta: int = 1) -> int:
+        """Server-side atomic add; returns the new value."""
+        reply = self.call("kv_incr", key=key, delta=int(delta))
+        if not reply.get("ok"):
+            raise CoordinatorError(f"kv_incr failed: {reply.get('error')}")
+        return int(reply["value"])
+
     def status(self) -> Dict:
         return self.call("status")
 

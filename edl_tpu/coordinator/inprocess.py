@@ -234,6 +234,14 @@ class InProcessCoordinator:
         with self._lock:
             self._kv.pop(key, None)
 
+    def kv_incr(self, key: str, delta: int = 1) -> int:
+        """Atomic counter (matches the C++ op_kv_incr): read-modify-write
+        under the lock, so concurrent failure-count bumps cannot be lost."""
+        with self._lock:
+            cur = int(self._kv.get(key, "0") or "0") + int(delta)
+            self._kv[key] = str(cur)
+            return cur
+
     def status(self) -> Dict:
         with self._lock:
             self._tick()
@@ -315,6 +323,9 @@ class InProcessClient:
 
     def kv_del(self, key):
         return self._c.kv_del(key)
+
+    def kv_incr(self, key, delta=1):
+        return self._c.kv_incr(key, delta)
 
     def status(self):
         return self._c.status()

@@ -93,6 +93,18 @@ class CoordinatorServer:
         self.stop()  # don't leak the subprocess (and its port) on timeout
         raise CoordinatorError("coordinator did not become ready")
 
+    def poll(self) -> Optional[int]:
+        """None while the coordinator process runs; its exit code otherwise."""
+        if self._proc is None:
+            return -1
+        return self._proc.poll()
+
+    def wait(self) -> int:
+        """Block until the coordinator process exits; returns its exit code."""
+        if self._proc is None:
+            return -1
+        return self._proc.wait()
+
     def stop(self) -> None:
         if self._proc is not None:
             self._proc.terminate()

@@ -1,0 +1,8 @@
+"""``python -m edl_tpu.launcher`` — the pod entrypoint, as `paddle_k8s` was
+the container entrypoint in the reference (`docker/paddle_k8s:238-263`)."""
+
+import sys
+
+from edl_tpu.launcher.launch import main
+
+sys.exit(main())

@@ -26,4 +26,20 @@ data values.
 from edl_tpu.models.base import Model
 from edl_tpu.models import fit_a_line, mnist, word2vec, ctr
 
-__all__ = ["Model", "ctr", "fit_a_line", "mnist", "word2vec"]
+
+_REGISTRY = {
+    "fit_a_line": fit_a_line.MODEL,
+    "mnist": mnist.MODEL,
+    "word2vec": word2vec.MODEL,
+    "ctr": ctr.MODEL,
+}
+
+
+def get(name: str) -> Model:
+    """Look up a zoo model's default instance by name."""
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; have {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "word2vec"]

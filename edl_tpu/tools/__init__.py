@@ -1,0 +1,5 @@
+"""Operational tooling: the metrics collector and CLI surfaces."""
+
+from edl_tpu.tools.collector import ClusterSample, Collector
+
+__all__ = ["ClusterSample", "Collector"]

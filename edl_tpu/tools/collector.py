@@ -1,0 +1,143 @@
+"""Cluster metrics collector.
+
+Equivalent of the reference's measurement harness
+(`example/fit_a_line/collector.py:27-226`), which defined the published
+experiment's metrics plane: submitted/pending job counts, running trainers per
+job, and cluster utilization, sampled on a fixed period (10 s print loop,
+`collector.py:215-226`). Ours reads the JobStore + ClusterProvider instead of
+the K8s API, adds TPU-chip utilization (the resource that matters here), and
+keeps samples as structured records so tests and benches can assert on them.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, TextIO
+
+from edl_tpu.api.types import JobPhase
+from edl_tpu.controller.cluster import ClusterProvider
+from edl_tpu.controller.jobparser import ROLE_TRAINER
+from edl_tpu.controller.store import JobStore
+
+
+@dataclass
+class ClusterSample:
+    """One observation (ref: the per-tick print block, collector.py:137-213)."""
+
+    timestamp: float
+    submitted_jobs: int
+    pending_jobs: int
+    running_jobs: int
+    #: job -> running trainer count (ref: RUNNING-TRAINERS per job).
+    running_trainers: Dict[str, int] = field(default_factory=dict)
+    #: job -> phase string.
+    phases: Dict[str, str] = field(default_factory=dict)
+    cpu_utilization: float = 0.0
+    tpu_utilization: float = 0.0
+    memory_utilization: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "timestamp": self.timestamp,
+            "submitted_jobs": self.submitted_jobs,
+            "pending_jobs": self.pending_jobs,
+            "running_jobs": self.running_jobs,
+            "running_trainers": dict(self.running_trainers),
+            "phases": dict(self.phases),
+            "cpu_utilization": round(self.cpu_utilization, 4),
+            "tpu_utilization": round(self.tpu_utilization, 4),
+            "memory_utilization": round(self.memory_utilization, 4),
+        }
+
+
+class Collector:
+    """Sample the control plane on a period; optionally stream JSON lines.
+
+    The reference printed CSV-ish lines every 10 s (`collector.py:215-226`);
+    we default to the same period and emit one JSON object per line.
+    """
+
+    def __init__(
+        self,
+        store: JobStore,
+        cluster: ClusterProvider,
+        period_seconds: float = 10.0,
+        sink: Optional[TextIO] = None,
+        max_samples: int = 100_000,
+    ):
+        self.store = store
+        self.cluster = cluster
+        self.period_seconds = period_seconds
+        self.sink = sink
+        self.samples: List[ClusterSample] = []
+        self._max = max_samples
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- one observation (ref: collector.py:95-213) ----------------------------
+
+    def sample(self) -> ClusterSample:
+        jobs = self.store.list()
+        snap = self.cluster.inquire()
+        running_trainers: Dict[str, int] = {}
+        phases: Dict[str, str] = {}
+        pending = running = 0
+        for job in jobs:
+            phases[job.name] = job.status.phase.value
+            pods = self.cluster.job_pods(job.name, ROLE_TRAINER)
+            running_trainers[job.name] = sum(1 for p in pods if p.phase == "Running")
+            if job.status.phase == JobPhase.RUNNING:
+                running += 1
+            # "Pending" in the reference: submitted but with no running pods yet
+            # (collector.py:95-118) — creation still in flight counts too.
+            elif job.status.phase in (JobPhase.NONE, JobPhase.CREATING):
+                pending += 1
+        s = ClusterSample(
+            timestamp=time.time(),
+            submitted_jobs=len(jobs),
+            pending_jobs=pending,
+            running_jobs=running,
+            running_trainers=running_trainers,
+            phases=phases,
+            cpu_utilization=snap.util("cpu"),
+            tpu_utilization=snap.util("tpu"),
+            memory_utilization=snap.util("memory"),
+        )
+        self.samples.append(s)
+        if len(self.samples) > self._max:
+            del self.samples[: len(self.samples) - self._max]
+        if self.sink is not None:
+            self.sink.write(json.dumps(s.to_dict()) + "\n")
+            self.sink.flush()
+        return s
+
+    # -- loop ------------------------------------------------------------------
+
+    def start(self) -> "Collector":
+        self._thread = threading.Thread(target=self._run, name="edl-collector", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample()
+            except Exception:  # keep observing through transient provider errors
+                pass
+            self._stop.wait(self.period_seconds)
+
+    # -- summaries the experiment report needs ---------------------------------
+
+    def peak_tpu_utilization(self) -> float:
+        return max((s.tpu_utilization for s in self.samples), default=0.0)
+
+    def latest(self) -> Optional[ClusterSample]:
+        return self.samples[-1] if self.samples else None

@@ -1,0 +1,74 @@
+"""CTR deep-wide elastic trainer — the flagship workload.
+
+Equivalent of `example/ctr/ctr/train.py:28-239`: argparse config surface,
+periodic checkpointing every N batches (rank 0's duty in the reference,
+`train.py:169-180`; here orbax-style saves are coordinated by the runtime),
+cloud vs local mode by env. The PS transpile + ParallelExecutor machinery
+(`train.py:141-151,211-231`) has no equivalent: one jitted SPMD step covers
+both, and elasticity is checkpoint-restore rescale instead of pserver-held
+state.
+"""
+
+import argparse
+import json
+import os
+import tempfile
+
+from edl_tpu.launcher.launch import LaunchContext
+from edl_tpu.models import ctr
+from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
+from edl_tpu.runtime.data import shard_names
+from edl_tpu.runtime.train_loop import TrainerConfig
+
+
+def parse_args():
+    # Config surface kept close to the reference's (train.py:28-117).
+    parser = argparse.ArgumentParser(description="CTR deep-wide elastic training")
+    parser.add_argument("--batch-size", type=int, default=8192)
+    parser.add_argument("--sparse-feature-dim", type=int, default=ctr.SPARSE_DIM)
+    parser.add_argument("--learning-rate", type=float, default=0.05)
+    parser.add_argument("--batches-per-shard", type=int, default=50)
+    parser.add_argument("--shard-axis", default="data",
+                        help="mesh axis the sparse tables shard over")
+    return parser.parse_args()
+
+
+def main() -> None:
+    args = parse_args()
+    ctx = LaunchContext.from_env()
+    model = ctr.make_model(shard_axis=args.shard_axis,
+                           sparse_dim=args.sparse_feature_dim)
+    source = SyntheticShardSource(model, batch_size=args.batch_size,
+                                  batches_per_shard=args.batches_per_shard)
+
+    if os.environ.get("EDL_COORDINATOR_ENDPOINT"):  # cloud mode (ref :192-203)
+        from edl_tpu.launcher.discovery import wait_coordinator
+
+        client = wait_coordinator(ctx.coordinator_endpoint)
+        client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
+    else:  # local twin
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        coord = InProcessCoordinator()
+        coord.add_tasks(ctx.data_shards or shard_names("criteo", 4))
+        client = coord.client("worker-0")
+        ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-ctr-")
+
+    worker = ElasticWorker(
+        model,
+        client,
+        source,
+        ElasticConfig(
+            checkpoint_dir=ctx.checkpoint_dir,
+            checkpoint_interval=ctx.checkpoint_interval,
+            trainer=TrainerConfig(optimizer="adagrad",
+                                  learning_rate=args.learning_rate),
+        ),
+        mesh_axes={k: v for k, v in ctx.mesh_axes.items() if k != "data"} or None,
+    )
+    metrics = worker.run()
+    print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
+
+
+if __name__ == "__main__":
+    main()

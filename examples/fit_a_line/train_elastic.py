@@ -1,0 +1,56 @@
+"""Fault-tolerant elastic fit_a_line trainer.
+
+Equivalent of `example/fit_a_line/train_ft.py:24-118` — the reference's
+flagship elasticity demo (etcd-discovered pservers + master task queue via
+``cloud_reader``). Here the ``EDL_*`` env protocol points at the coordinator;
+shards are leased, membership changes trigger checkpoint-restore rescale.
+
+Runs standalone too (no env set): spawns an in-process coordinator, seeds
+shards, and trains through a simulated membership change.
+"""
+
+import json
+import os
+import tempfile
+
+from edl_tpu.launcher.launch import LaunchContext
+from edl_tpu.models import fit_a_line
+from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
+from edl_tpu.runtime.data import shard_names
+from edl_tpu.runtime.train_loop import TrainerConfig
+
+
+def main() -> None:
+    ctx = LaunchContext.from_env()
+    model = fit_a_line.MODEL
+    source = SyntheticShardSource(model, batch_size=256, batches_per_shard=20)
+
+    if os.environ.get("EDL_COORDINATOR_ENDPOINT"):
+        from edl_tpu.launcher.discovery import wait_coordinator
+
+        client = wait_coordinator(ctx.coordinator_endpoint)
+        client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
+    else:  # hermetic demo mode
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        coord = InProcessCoordinator()
+        coord.add_tasks(ctx.data_shards or shard_names("uci", 8))
+        client = coord.client("worker-0")
+        ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-fit-")
+
+    worker = ElasticWorker(
+        model,
+        client,
+        source,
+        ElasticConfig(
+            checkpoint_dir=ctx.checkpoint_dir,
+            checkpoint_interval=ctx.checkpoint_interval,
+            trainer=TrainerConfig(optimizer="sgd", learning_rate=1e-2),
+        ),
+    )
+    metrics = worker.run()
+    print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
+
+
+if __name__ == "__main__":
+    main()

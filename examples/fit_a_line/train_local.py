@@ -1,0 +1,51 @@
+"""Local fit_a_line training — the single-process twin.
+
+Equivalent of `example/fit_a_line/train_local.py:41-109` (UCI-housing linear
+regression, local SGD, per-pass checkpoint): same workload on the JAX backend
+with the framework's Trainer + Checkpointer instead of Paddle v2 +
+``save_parameter_to_tar``.
+"""
+
+import argparse
+import json
+
+import numpy as np
+
+import jax
+
+from edl_tpu.models import fit_a_line
+from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.runtime import Checkpointer, Trainer, TrainerConfig
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--passes", type=int, default=10)
+    parser.add_argument("--steps-per-pass", type=int, default=50)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--checkpoint-dir", default="")
+    args = parser.parse_args()
+
+    mesh = build_mesh(MeshSpec({"data": len(jax.devices())}))
+    trainer = Trainer(fit_a_line.MODEL, mesh,
+                      TrainerConfig(optimizer="sgd", learning_rate=args.lr))
+    state = trainer.init_state()
+    ckpt = Checkpointer(args.checkpoint_dir) if args.checkpoint_dir else None
+    rng = np.random.default_rng(0)
+
+    for pass_id in range(args.passes):
+        batches = (
+            fit_a_line.MODEL.synthetic_batch(rng, args.batch_size)
+            for _ in range(args.steps_per_pass)
+        )
+        state, metrics = trainer.run(state, batches)
+        print(json.dumps({"pass": pass_id, **{k: round(v, 4) for k, v in metrics.items()}}))
+        if ckpt is not None:  # per-pass save (ref: train_local.py:95-96)
+            ckpt.save(int(state.step), state)
+    if ckpt is not None:
+        ckpt.wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -323,6 +323,7 @@ class Coordinator {
   std::string op_kv_put(const JsonObject& req);
   std::string op_kv_get(const JsonObject& req);
   std::string op_kv_del(const JsonObject& req);
+  std::string op_kv_incr(const JsonObject& req);
   std::string op_status();
 
   void bump_epoch() { epoch_++; }
@@ -598,6 +599,25 @@ std::string Coordinator::op_kv_del(const JsonObject& req) {
   return JsonWriter().field("ok", true).done();
 }
 
+std::string Coordinator::op_kv_incr(const JsonObject& req) {
+  // Atomic counter: read-modify-write under the server's single-threaded
+  // event loop, so concurrent clients (e.g. trainers bumping the job-wide
+  // failure count) can never lose increments the way kv_get+kv_put can.
+  std::string key = get_str(req, "key");
+  if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
+  long long delta = (long long)get_num(req, "delta", 1.0);
+  long long cur = 0;
+  auto it = kv_.find(key);
+  if (it != kv_.end()) {
+    try { cur = std::stoll(it->second); } catch (...) {
+      return JsonWriter().field("ok", false).field("error", "value not an integer").done();
+    }
+  }
+  cur += delta;
+  kv_[key] = std::to_string(cur);
+  return JsonWriter().field("ok", true).field("value", (double)cur).done();
+}
+
 std::string Coordinator::op_status() {
   return JsonWriter()
       .field("ok", true)
@@ -624,6 +644,7 @@ std::string Coordinator::handle(const JsonObject& req, int fd) {
   if (op == "kv_put") return op_kv_put(req);
   if (op == "kv_get") return op_kv_get(req);
   if (op == "kv_del") return op_kv_del(req);
+  if (op == "kv_incr") return op_kv_incr(req);
   if (op == "status") return op_status();
   if (op == "ping") return JsonWriter().field("ok", true).field("pong", true).done();
   return JsonWriter().field("ok", false).field("error", "unknown op: " + op).done();
