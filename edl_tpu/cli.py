@@ -78,6 +78,12 @@ def cmd_run(args) -> int:
     from edl_tpu.controller import Controller
     from edl_tpu.tools.collector import Collector
 
+    try:  # parse before the control plane spins up, and fail like validate
+        parsed = _load_job(args.file)
+    except (ValidationError, ValueError, KeyError) as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+
     cluster = _make_fake_cluster(args)
     controller = Controller(cluster, max_load_desired=args.max_load_desired)
     controller.start()
@@ -85,7 +91,7 @@ def cmd_run(args) -> int:
                           period_seconds=args.collect_period, sink=sys.stderr)
     collector.start()
     try:
-        job = controller.submit(_load_job(args.file))
+        job = controller.submit(parsed)
         deadline = time.monotonic() + args.timeout
         while time.monotonic() < deadline:
             status = controller.job_status(job.name, job.namespace).status

@@ -71,6 +71,7 @@ class JobUpdater:
         self._stop = threading.Event()
         self._deleted = threading.Event()  # deletion requested
         self._gc_done = threading.Event()  # resources torn down
+        self._gc_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._last_written_status: Optional[tuple] = None
         self.done = threading.Event()  # set once the actor exits
@@ -234,9 +235,10 @@ class JobUpdater:
     # -- teardown (ref: deleteTrainingJob + pod GC, :99-207) -------------------
 
     def _gc_resources(self) -> None:
-        if self._gc_done.is_set():  # idempotent: actor + caller may both reach it
-            return
-        self._gc_done.set()
+        with self._gc_lock:  # idempotent: actor + caller may both reach it
+            if self._gc_done.is_set():
+                return
+            self._gc_done.set()
         for role in (ROLE_TRAINER, ROLE_COORDINATOR):
             try:
                 self.cluster.delete_role(self.job.name, role)
@@ -266,6 +268,11 @@ class JobUpdater:
                 if self.job.status.phase.terminal():
                     return
         finally:
+            # Set done BEFORE checking _deleted, mirroring notify_delete's
+            # set-_deleted-then-check-done: whichever thread writes second is
+            # guaranteed to see the other's flag, so GC cannot be skipped when
+            # notify_delete races the actor's exit. (_gc_resources is
+            # lock-idempotent, so both seeing it is fine.)
+            self.done.set()
             if self._deleted.is_set():
                 self._gc_resources()
-            self.done.set()

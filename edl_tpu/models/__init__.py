@@ -24,7 +24,7 @@ data values.
 """
 
 from edl_tpu.models.base import Model
-from edl_tpu.models import fit_a_line, mnist, word2vec, ctr
+from edl_tpu.models import fit_a_line, mnist, word2vec, ctr, transformer
 
 
 _REGISTRY = {
@@ -32,6 +32,7 @@ _REGISTRY = {
     "mnist": mnist.MODEL,
     "word2vec": word2vec.MODEL,
     "ctr": ctr.MODEL,
+    "transformer": transformer.MODEL,
 }
 
 
@@ -42,4 +43,4 @@ def get(name: str) -> Model:
     return _REGISTRY[name]
 
 
-__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "word2vec"]
+__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "transformer", "word2vec"]

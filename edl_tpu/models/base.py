@@ -20,7 +20,7 @@ change.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
@@ -35,3 +35,8 @@ class Model:
     loss_fn: Callable  # (params, batch, mesh) -> scalar
     param_spec: Callable  # (mesh) -> PartitionSpec pytree
     synthetic_batch: Callable  # (np.random.Generator, batch_size) -> Batch
+    #: optional (mesh) -> {batch key: PartitionSpec}. Default None = every
+    #: array sharded on dim 0 over the trainer's batch axis; models with
+    #: sequence-sharded inputs (transformer: tokens (B, S) over data x seq)
+    #: override this so `Trainer.place_batch` places dims on the right axes.
+    batch_spec: Optional[Callable] = None

@@ -1,0 +1,169 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+The reference predates long context (its longest "sequence" is a 5-gram
+window, `example/fit_a_line/train_ft.py:26`); this framework makes sequence
+parallelism first-class. Q/K/V live sharded on the sequence dimension across
+the ``seq`` axis; each device computes attention for its local query block
+while K/V blocks rotate around the ring via `jax.lax.ppermute`, one hop per
+step, overlapping the ICI transfer with the block matmuls. Softmax is the
+blockwise online form (flash-attention accumulation): running max ``m``,
+numerator ``num`` and denominator ``den`` are updated per visiting block, so
+the full (S, S) score matrix never materializes and memory stays
+O(S_local^2 / n_shards) per device.
+
+Causality is enforced with *global* positions reconstructed from the ring
+topology: the block arriving at step ``i`` originated on device
+``(my_index - i) mod n``, so its key positions are known statically per step
+and the mask costs one compare, no communication.
+
+The public entrypoint wraps its own `shard_map`; `_ring_attention_local` is
+the inside-a-shard_map form reused by models that are already manual over the
+mesh (e.g. `edl_tpu.models.transformer`).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: scores below this are "masked"; finite so exp() is exactly 0 without nans.
+_NEG_INF = -1e30
+
+
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference O(S^2)-memory attention. q/k/v: (B, S, H, D).
+
+    The correctness oracle for the ring kernel and the single-device
+    fallback; f32 softmax regardless of input dtype.
+    """
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        pos = jnp.arange(S)
+        s = jnp.where(pos[None, :] <= pos[:, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def _ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str,
+    n_shards: int,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention over local shards — call inside a shard_map whose manual
+    axes include ``seq_axis``. q/k/v: (B, S_local, H_local, D)."""
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if n_shards == 1:
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+
+    my = jax.lax.axis_index(seq_axis)
+    q_pos = my * S + jnp.arange(S)  # global positions of local queries
+    ring = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    qf = q.astype(jnp.float32)
+
+    def accumulate(acc, k_blk, v_blk, src):
+        """Fold one visiting K/V block into the online-softmax accumulator."""
+        m, num, den = acc
+        k_pos = src * S + jnp.arange(S)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            mask = k_pos[None, :] <= q_pos[:, None]  # (S_q, S_k)
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))  # (B, H, S_q)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])  # (B, H, S_q, S_k)
+        num = num * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        den = den * alpha + p.sum(axis=-1)
+        return m_new, num, den
+
+    def step(carry, i):
+        k_blk, v_blk, acc = carry
+        # Rotate first: the last step's output IS consumed, so exactly
+        # n_shards-1 hops move each block all the way around the ring.
+        k_blk = jax.lax.ppermute(k_blk, seq_axis, ring)
+        v_blk = jax.lax.ppermute(v_blk, seq_axis, ring)
+        acc = accumulate(acc, k_blk, v_blk, src=(my - i) % n_shards)
+        return (k_blk, v_blk, acc), None
+
+    m0 = jnp.full((B, H, S), _NEG_INF, jnp.float32)
+    num0 = jnp.zeros((B, H, S, D), jnp.float32)
+    den0 = jnp.zeros((B, H, S), jnp.float32)
+    acc0 = accumulate((m0, num0, den0), k, v, src=my)  # local block, hop 0
+    (_, _, (_, num, den)), _ = jax.lax.scan(
+        step, (k, v, acc0), jnp.arange(1, n_shards)
+    )
+    out = num / den[..., None]  # (B, H, S_q, D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _qkv_spec(mesh: Mesh, batch_axis: str, seq_axis: str, head_axis: str) -> P:
+    """(B, S, H, D) spec using only axes the mesh actually has."""
+    have = mesh.axis_names
+    return P(
+        batch_axis if batch_axis in have else None,
+        seq_axis if seq_axis in have else None,
+        head_axis if head_axis in have else None,
+        None,
+    )
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    seq_axis: str = "seq",
+    batch_axis: str = "data",
+    head_axis: str = "model",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence-parallel attention on a mesh. q/k/v: (B, S, H, D) global.
+
+    Sharding: batch over ``batch_axis``, sequence over ``seq_axis``, heads
+    over ``head_axis`` (attention is embarrassingly parallel over batch and
+    heads; only the sequence axis communicates). Axes absent from the mesh are
+    simply unsharded. With no ``seq_axis`` in the mesh this degrades to dense
+    attention under `jit` sharding propagation.
+    """
+    if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    spec = _qkv_spec(mesh, batch_axis, seq_axis, head_axis)
+    kernel = partial(
+        _ring_attention_local,
+        seq_axis=seq_axis,
+        n_shards=mesh.shape[seq_axis],
+        causal=causal,
+        scale=scale,
+    )
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

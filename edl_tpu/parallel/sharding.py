@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -24,8 +24,17 @@ def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return replicate(mesh)
 
 
-def shard_batch(batch: Any, mesh: Mesh, axis: str = "data"):
-    """Place a host-side batch pytree onto the mesh, sharded on dim 0.
+def shard_batch(
+    batch: Any,
+    mesh: Mesh,
+    axis: str = "data",
+    specs: Optional[Dict[str, P]] = None,
+):
+    """Place a host-side batch pytree onto the mesh.
+
+    Default: every array sharded on dim 0 over ``axis``. With ``specs`` (a
+    per-key PartitionSpec map from `Model.batch_spec`), each array gets its
+    own layout — e.g. transformer tokens (B, S) over data x seq.
 
     The per-trainer data path: each trainer produces its local slice of the
     global batch (from its leased data shards); `jax.device_put` with a
@@ -33,6 +42,11 @@ def shard_batch(batch: Any, mesh: Mesh, axis: str = "data"):
     per-trainer file-shard reader (`example/fit_a_line/fluid/common.py:24-40`,
     `idx % trainers == trainer_id`).
     """
+    if specs is not None:
+        return {
+            k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
+            for k, v in batch.items()
+        }
     sharding = batch_sharding(mesh, axis)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(jnp.asarray(x), sharding), batch

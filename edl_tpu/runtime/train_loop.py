@@ -94,7 +94,12 @@ class Trainer:
     # -- stepping --------------------------------------------------------------
 
     def place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-        return shard_batch(batch, self.mesh, self.config.batch_axis)
+        specs = (
+            self.model.batch_spec(self.mesh)
+            if self.model.batch_spec is not None
+            else None
+        )
+        return shard_batch(batch, self.mesh, self.config.batch_axis, specs=specs)
 
     def train_step(self, state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, jax.Array]:
         return self._jit_step(state, batch)

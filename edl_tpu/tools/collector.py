@@ -12,6 +12,7 @@ keeps samples as structured records so tests and benches can assert on them.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -21,6 +22,8 @@ from edl_tpu.api.types import JobPhase
 from edl_tpu.controller.cluster import ClusterProvider
 from edl_tpu.controller.jobparser import ROLE_TRAINER
 from edl_tpu.controller.store import JobStore
+
+log = logging.getLogger("edl_tpu.collector")
 
 
 @dataclass
@@ -131,7 +134,7 @@ class Collector:
             try:
                 self.sample()
             except Exception:  # keep observing through transient provider errors
-                pass
+                log.exception("collector sample failed")
             self._stop.wait(self.period_seconds)
 
     # -- summaries the experiment report needs ---------------------------------

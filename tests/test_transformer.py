@@ -1,0 +1,81 @@
+"""Transformer LM: sharding equivalence across dp/sp/tp meshes + training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from edl_tpu.models import transformer
+from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.runtime import Trainer, TrainerConfig
+
+CFG = transformer.TransformerConfig(
+    vocab_size=64, d_model=32, n_layers=2, n_heads=8, d_ff=64, seq_len=16
+)
+
+
+def _loss_on(axes, batch):
+    """Init on a single-device mesh deterministically, reshard to `axes`."""
+    mesh = build_mesh(MeshSpec(axes))
+    model = transformer.make_model(CFG)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    placed = {
+        k: jax.device_put(
+            jnp.asarray(v),
+            jax.sharding.NamedSharding(mesh, model.batch_spec(mesh)[k]),
+        )
+        for k, v in batch.items()
+    }
+    return float(model.loss_fn(params, placed, mesh))
+
+
+def test_loss_identical_across_mesh_layouts():
+    """Same params/batch -> same loss whether sharded dp, sp, tp or mixed.
+
+    This is the capability the reference's DistributeTranspiler could never
+    offer: distribution changes the layout, not the math.
+    """
+    batch = transformer.synthetic_batch(CFG, np.random.default_rng(0), 8)
+    ref = _loss_on({"data": 8}, batch)
+    for axes in ({"seq": 8}, {"model": 8}, {"data": 2, "seq": 2, "model": 2},
+                 {"data": 2, "seq": 4}, {"data": 4, "model": 2}):
+        got = _loss_on(axes, batch)
+        assert got == pytest.approx(ref, rel=2e-2), (axes, got, ref)
+
+
+def test_train_step_decreases_loss_on_3d_mesh():
+    mesh = build_mesh(MeshSpec({"data": 2, "seq": 2, "model": 2}))
+    model = transformer.make_model(CFG)
+    trainer = Trainer(model, mesh, TrainerConfig(optimizer="adam", learning_rate=1e-3))
+    state = trainer.init_state()
+    rng = np.random.default_rng(1)
+    batch = model.synthetic_batch(rng, 8)
+    placed = trainer.place_batch(batch)
+    losses = []
+    for _ in range(8):
+        state, loss = trainer.train_step(state, placed)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 8
+
+
+def test_param_shardings_land_on_axes():
+    mesh = build_mesh(MeshSpec({"data": 2, "model": 4}))
+    model = transformer.make_model(CFG)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    wqkv = params["blocks"]["wqkv"]
+    # col-sharded over model: local shard of the head dim is H/tp
+    assert wqkv.sharding.spec == jax.sharding.PartitionSpec(
+        None, None, None, "model", None
+    )
+    assert params["embed"].sharding.spec == jax.sharding.PartitionSpec(None, None)
+
+
+def test_invalid_divisibility_raises():
+    mesh = build_mesh(MeshSpec({"model": 8}))
+    bad = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=1, n_heads=4, d_ff=64, seq_len=16
+    )  # 4 heads cannot split over tp=8
+    model = transformer.make_model(bad)
+    with pytest.raises(ValueError):
+        model.init(jax.random.PRNGKey(0), mesh)
