@@ -78,8 +78,8 @@ def cmd_run(args) -> int:
     from edl_tpu.controller import Controller
     from edl_tpu.tools.collector import Collector
 
-    try:  # parse before the control plane spins up, and fail like validate
-        parsed = _load_job(args.file)
+    try:  # parse + admission-validate before the control plane spins up
+        parsed = normalize(_load_job(args.file))
     except (ValidationError, ValueError, KeyError) as e:
         print(f"INVALID: {e}", file=sys.stderr)
         return 1

@@ -235,15 +235,20 @@ class JobUpdater:
     # -- teardown (ref: deleteTrainingJob + pod GC, :99-207) -------------------
 
     def _gc_resources(self) -> None:
-        with self._gc_lock:  # idempotent: actor + caller may both reach it
+        # Lock held through the teardown itself, not just the flag: a caller
+        # returning from notify_delete must observe resources GONE, not
+        # in-flight (the loser of the race blocks until the winner finishes).
+        with self._gc_lock:
             if self._gc_done.is_set():
                 return
+            for role in (ROLE_TRAINER, ROLE_COORDINATOR):
+                try:
+                    self.cluster.delete_role(self.job.name, role)
+                except Exception:
+                    log.exception(
+                        "deleting role %s of %s failed", role, self.job.name
+                    )
             self._gc_done.set()
-        for role in (ROLE_TRAINER, ROLE_COORDINATOR):
-            try:
-                self.cluster.delete_role(self.job.name, role)
-            except Exception:
-                log.exception("deleting role %s of %s failed", role, self.job.name)
 
     # -- actor loop (ref: start, :453-481) -------------------------------------
 

@@ -12,6 +12,11 @@ axis the parallel layer supports, together and composably:
 - ``model`` — megatron-style tensor parallelism: QKV/up projections
   column-sharded, output/down projections row-sharded, one `psum` after each
   (two per block), heads split across the axis.
+- ``pipe``  — pipeline parallelism: the block stack's leading layer dim is
+  sharded over the axis (each rank holds n_layers/pp contiguous blocks) and
+  executed with the GPipe microbatch schedule
+  (`edl_tpu.parallel.pipeline._pipeline_local`), composing with ring
+  attention and the TP psums inside each stage.
 
 The whole forward/loss is ONE `shard_map` kernel, manual over the mesh: every
 matmul below is written against local shards, so the collectives are explicit
@@ -39,6 +44,7 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from edl_tpu.models.base import Model
+from edl_tpu.parallel.pipeline import _pipeline_local
 from edl_tpu.parallel.ring_attention import _ring_attention_local
 
 
@@ -53,6 +59,9 @@ class TransformerConfig:
     batch_axis: str = "data"
     seq_axis: str = "seq"
     tp_axis: str = "model"
+    pp_axis: str = "pipe"
+    #: microbatches for the pipeline schedule; None = stage count.
+    microbatches: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -74,19 +83,22 @@ def _maybe_psum(x: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
 
 
 def _block_spec(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
-    """Specs for the stacked (leading dim = n_layers) block params."""
+    """Specs for the stacked (leading dim = n_layers) block params. The
+    leading layer dim shards over the pipe axis: each pipeline rank holds
+    its contiguous chunk of blocks."""
     tp = cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None
+    pp = cfg.pp_axis if cfg.pp_axis in mesh.axis_names else None
     return {
-        "ln1": P(None, None),
-        "wqkv": P(None, None, None, tp, None),  # (L, D, 3, H, Dh) col-sharded
-        "bqkv": P(None, None, tp, None),
-        "wo": P(None, tp, None, None),  # (L, H, Dh, D) row-sharded -> psum
-        "bo": P(None, None),
-        "ln2": P(None, None),
-        "win": P(None, None, tp),  # (L, D, F) col-sharded
-        "bin": P(None, tp),
-        "wout": P(None, tp, None),  # (L, F, D) row-sharded -> psum
-        "bout": P(None, None),
+        "ln1": P(pp, None),
+        "wqkv": P(pp, None, None, tp, None),  # (L, D, 3, H, Dh) col-sharded
+        "bqkv": P(pp, None, tp, None),
+        "wo": P(pp, tp, None, None),  # (L, H, Dh, D) row-sharded -> psum
+        "bo": P(pp, None),
+        "ln2": P(pp, None),
+        "win": P(pp, None, tp),  # (L, D, F) col-sharded
+        "bin": P(pp, tp),
+        "wout": P(pp, tp, None),  # (L, F, D) row-sharded -> psum
+        "bout": P(pp, None),
     }
 
 
@@ -104,11 +116,17 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
     tp = _axis_size(mesh, cfg.tp_axis)
     if cfg.n_heads % tp or cfg.d_ff % tp:
         raise ValueError(
-            f"n_heads={cfg.n_heads} and d_ff={cfg.d_ff} must divide tp={tp}"
+            f"n_heads={cfg.n_heads} and d_ff={cfg.d_ff} must be divisible by tp={tp}"
         )
     if cfg.seq_len % _axis_size(mesh, cfg.seq_axis):
         raise ValueError(
-            f"seq_len={cfg.seq_len} must divide sp={_axis_size(mesh, cfg.seq_axis)}"
+            f"seq_len={cfg.seq_len} must be divisible by "
+            f"sp={_axis_size(mesh, cfg.seq_axis)}"
+        )
+    if cfg.n_layers % _axis_size(mesh, cfg.pp_axis):
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must be divisible by "
+            f"pp={_axis_size(mesh, cfg.pp_axis)}"
         )
     D, H, Dh, F, L, V = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
@@ -184,11 +202,27 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
     x = params["embed"][tokens] + params["pos"][pos]
     x = x.astype(jnp.bfloat16)
 
-    x, _ = jax.lax.scan(
-        lambda c, bp: (_block(cfg, mesh, n_sp, c, bp), None),
-        x,
-        params["blocks"],
-    )
+    def stage(blocks_local, h):
+        """Apply this rank's chunk of blocks (whole stack when pp absent)."""
+        h, _ = jax.lax.scan(
+            lambda c, bp: (_block(cfg, mesh, n_sp, c, bp), None),
+            h,
+            blocks_local,
+        )
+        return h
+
+    n_pp = _axis_size(mesh, cfg.pp_axis)
+    if n_pp > 1:
+        x = _pipeline_local(
+            stage,
+            params["blocks"],
+            x,
+            pipe_axis=cfg.pp_axis,
+            n_stages=n_pp,
+            microbatches=cfg.microbatches or n_pp,
+        )
+    else:
+        x = stage(params["blocks"], x)
 
     h = _rmsnorm(x, params["lnf"]).astype(jnp.float32)
     logits = jnp.einsum("bsd,dv->bsv", h, params["head"])  # (Bl, Sl, V) f32

@@ -23,14 +23,19 @@ from edl_tpu.parallel.sharding import (
     shard_batch,
 )
 from edl_tpu.parallel.embedding import ShardedEmbedding
+from edl_tpu.parallel.pipeline import pipeline_apply
+from edl_tpu.parallel.ring_attention import dense_attention, ring_attention
 
 __all__ = [
     "MeshSpec",
     "ShardedEmbedding",
     "batch_sharding",
     "build_mesh",
+    "dense_attention",
     "local_mesh",
     "named_sharding",
+    "pipeline_apply",
     "replicate",
+    "ring_attention",
     "shard_batch",
 ]

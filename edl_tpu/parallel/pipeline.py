@@ -1,0 +1,122 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pipe`` axis.
+
+The reference has no pipeline parallelism (SURVEY §2.3) — its distribution is
+data-parallel PS only — but a TPU framework schedules models too big for one
+chip's HBM, so stages are first-class here. Design:
+
+- Stage parameters are a pytree whose LEADING dim is the stage index, sharded
+  over the ``pipe`` mesh axis: each device holds one stage's weights (for a
+  transformer, its contiguous chunk of layers).
+- The schedule is the classic (microbatches + stages - 1)-tick loop: at tick
+  ``t`` stage ``r`` processes microbatch ``t - r``; activations hop one ICI
+  neighbor per tick via `jax.lax.ppermute`. Warmup/drain bubble ticks compute
+  on garbage that is masked out of the output and carries zero cotangent, so
+  the whole schedule is differentiable through `jax.lax.scan`.
+- Stage outputs must have the stage-input shape (the standard homogeneous-
+  stage restriction; residual-stream models satisfy it by construction).
+
+`_pipeline_local` is the inside-a-shard_map form (composable with tensor and
+sequence parallelism — the transformer calls it with ring attention inside the
+stage function); `pipeline_apply` wraps it for standalone use.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    pipe_axis: str,
+    n_stages: int,
+    microbatches: int,
+) -> jax.Array:
+    """Run the pipeline schedule on local shards — call inside a shard_map
+    whose manual axes include ``pipe_axis``.
+
+    ``stage_params`` is THIS device's stage slice (leading stage dim already
+    consumed by the enclosing in_spec). ``x``: (B_local, ...) activations; the
+    full batch enters at stage 0 and the result is psum-broadcast to all
+    stages so downstream (loss) code stays SPMD-uniform.
+    """
+    if n_stages == 1:
+        return stage_fn(stage_params, x)
+    M = microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"local batch {B} must be divisible by microbatches {M}")
+    mb = x.reshape((M, B // M) + x.shape[1:])
+    idx = jax.lax.axis_index(pipe_axis)
+    fwd = [(i, i + 1) for i in range(n_stages - 1)]  # stage r -> r+1, no wrap
+
+    def tick(carry, t):
+        state, outs = carry
+        # Stage 0 feeds microbatch t (clipped re-feeds during drain are
+        # masked garbage); later stages consume the hop received last tick.
+        inp = jnp.where(idx == 0, mb[jnp.clip(t, 0, M - 1)], state)
+        y = stage_fn(stage_params, inp)
+        opos = jnp.clip(t - (n_stages - 1), 0, M - 1)
+        write = (idx == n_stages - 1) & (t >= n_stages - 1)
+        prev = jax.lax.dynamic_index_in_dim(outs, opos, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(write, y, prev), opos, 0
+        )
+        state = jax.lax.ppermute(y, pipe_axis, fwd)
+        return (state, outs), None
+
+    state0 = jnp.zeros_like(mb[0])
+    outs0 = jnp.zeros_like(mb)
+    (_, outs), _ = jax.lax.scan(
+        tick, (state0, outs0), jnp.arange(M + n_stages - 1)
+    )
+    # Only the last stage wrote real outputs (zeros elsewhere): broadcast.
+    outs = jax.lax.psum(jnp.where(idx == n_stages - 1, outs, 0), pipe_axis)
+    return outs.reshape((B,) + x.shape[1:])
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    pipe_axis: str = "pipe",
+    batch_axis: str = "data",
+    microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Standalone pipeline over ``mesh``. ``stage_params`` leaves have a
+    leading stage dim == pipe axis size; ``x`` (B, ...) is batch-sharded over
+    ``batch_axis``. ``microbatches`` defaults to the stage count (bubble
+    fraction (n-1)/(M+n-1); raise it to shrink the bubble)."""
+    if pipe_axis not in mesh.axis_names or mesh.shape[pipe_axis] == 1:
+        one = jax.tree_util.tree_map(lambda a: a[0], stage_params)
+        return stage_fn(one, x)
+    n = mesh.shape[pipe_axis]
+    M = microbatches or n
+
+    param_specs = jax.tree_util.tree_map(lambda _: P(pipe_axis), stage_params)
+    x_spec = P(batch_axis if batch_axis in mesh.axis_names else None)
+
+    def kernel(params_local, x_local):
+        one = jax.tree_util.tree_map(lambda a: a[0], params_local)
+        return _pipeline_local(
+            stage_fn, one, x_local, pipe_axis=pipe_axis, n_stages=n,
+            microbatches=M,
+        )
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(param_specs, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)

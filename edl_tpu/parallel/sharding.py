@@ -28,13 +28,13 @@ def shard_batch(
     batch: Any,
     mesh: Mesh,
     axis: str = "data",
-    specs: Optional[Dict[str, P]] = None,
+    specs: Optional[Any] = None,
 ):
     """Place a host-side batch pytree onto the mesh.
 
     Default: every array sharded on dim 0 over ``axis``. With ``specs`` (a
-    per-key PartitionSpec map from `Model.batch_spec`), each array gets its
-    own layout — e.g. transformer tokens (B, S) over data x seq.
+    PartitionSpec pytree matching ``batch``, from `Model.batch_spec`), each
+    array gets its own layout — e.g. transformer tokens (B, S) over data x seq.
 
     The per-trainer data path: each trainer produces its local slice of the
     global batch (from its leased data shards); `jax.device_put` with a
@@ -43,10 +43,12 @@ def shard_batch(
     `idx % trainers == trainer_id`).
     """
     if specs is not None:
-        return {
-            k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
-            for k, v in batch.items()
-        }
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
+            batch,
+            specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
     sharding = batch_sharding(mesh, axis)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(jnp.asarray(x), sharding), batch

@@ -38,7 +38,10 @@ def test_loss_identical_across_mesh_layouts():
     batch = transformer.synthetic_batch(CFG, np.random.default_rng(0), 8)
     ref = _loss_on({"data": 8}, batch)
     for axes in ({"seq": 8}, {"model": 8}, {"data": 2, "seq": 2, "model": 2},
-                 {"data": 2, "seq": 4}, {"data": 4, "model": 2}):
+                 {"data": 2, "seq": 4}, {"data": 4, "model": 2},
+                 {"pipe": 2, "data": 2, "seq": 2},
+                 {"pipe": 2, "seq": 2, "model": 2},
+                 {"pipe": 2, "data": 4}):
         got = _loss_on(axes, batch)
         assert got == pytest.approx(ref, rel=2e-2), (axes, got, ref)
 
