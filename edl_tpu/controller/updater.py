@@ -106,12 +106,15 @@ class JobUpdater:
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
         self._enqueue("stop")
+        deadline = time.monotonic() + timeout
         if self._thread:
             self._thread.join(timeout=timeout)
         # A delete requested but never processed (actor raced past the event)
-        # must still tear down.
+        # must still tear down — but within stop()'s remaining time budget:
+        # if the actor thread is wedged inside _gc_resources holding the
+        # lock, we return without waiting unboundedly for it.
         if self._deleted.is_set():
-            self._gc_resources()
+            self._gc_resources(lock_timeout=max(0.0, deadline - time.monotonic()))
 
     def _enqueue(self, kind: str) -> None:
         if self._events.qsize() >= EVENT_QUEUE_HIGH_WATER:
@@ -234,11 +237,21 @@ class JobUpdater:
 
     # -- teardown (ref: deleteTrainingJob + pod GC, :99-207) -------------------
 
-    def _gc_resources(self) -> None:
+    def _gc_resources(self, lock_timeout: Optional[float] = None) -> None:
         # Lock held through the teardown itself, not just the flag: a caller
         # returning from notify_delete must observe resources GONE, not
         # in-flight (the loser of the race blocks until the winner finishes).
-        with self._gc_lock:
+        # ``lock_timeout`` bounds that wait for callers with their own
+        # deadline (stop()); None preserves the block-until-done contract.
+        acquired = self._gc_lock.acquire(
+            timeout=lock_timeout if lock_timeout is not None else -1
+        )
+        if not acquired:
+            log.warning(
+                "gc of %s still in flight elsewhere; not waiting", self.job.name
+            )
+            return
+        try:
             if self._gc_done.is_set():
                 return
             for role in (ROLE_TRAINER, ROLE_COORDINATOR):
@@ -249,6 +262,8 @@ class JobUpdater:
                         "deleting role %s of %s failed", role, self.job.name
                     )
             self._gc_done.set()
+        finally:
+            self._gc_lock.release()
 
     # -- actor loop (ref: start, :453-481) -------------------------------------
 

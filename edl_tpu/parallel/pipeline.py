@@ -98,8 +98,14 @@ def pipeline_apply(
     ``batch_axis``. ``microbatches`` defaults to the stage count (bubble
     fraction (n-1)/(M+n-1); raise it to shrink the bubble)."""
     if pipe_axis not in mesh.axis_names or mesh.shape[pipe_axis] == 1:
-        one = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-        return stage_fn(one, x)
+        # No pipe axis on this mesh (e.g. after an elastic rescale dropped
+        # it): run every stage sequentially on each device.
+        n_stages = jax.tree_util.tree_leaves(stage_params)[0].shape[0]
+        out = x
+        for i in range(n_stages):
+            one = jax.tree_util.tree_map(lambda a, i=i: a[i], stage_params)
+            out = stage_fn(one, out)
+        return out
     n = mesh.shape[pipe_axis]
     M = microbatches or n
 

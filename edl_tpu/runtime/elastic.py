@@ -85,6 +85,7 @@ class ElasticWorker:
         config: ElasticConfig,
         device_planner: Optional[Callable[[int], Sequence[jax.Device]]] = None,
         mesh_axes: Optional[Dict[str, int]] = None,
+        profiler=None,  # optional edl_tpu.tools.profiler.StepProfiler
     ):
         if not config.checkpoint_dir:
             raise ValueError("ElasticConfig.checkpoint_dir is required")
@@ -94,6 +95,7 @@ class ElasticWorker:
         self.config = config
         self.planner = device_planner or default_device_planner(4)
         self.mesh_axes = mesh_axes  # extra non-data axes, sized per full mesh
+        self.profiler = profiler
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.rescales: List[RescaleEvent] = []
         self.steps_done = 0
@@ -204,9 +206,13 @@ class ElasticWorker:
                 reader = LeaseReader(
                     self.client, self.source, stop_check=self._epoch_changed
                 )
+                if self.profiler is not None:
+                    self.profiler.start()
                 for batch in reader:
                     placed = trainer.place_batch(batch)
                     state, loss = trainer.train_step(state, placed)
+                    if self.profiler is not None:
+                        self.profiler.step(len(next(iter(batch.values()))))
                     if not first_step_done:
                         first_step_done = True
                         recovery = time.perf_counter() - rescale_t0
@@ -253,7 +259,12 @@ class ElasticWorker:
             # Queue exhausted: final checkpoint and finish.
             self._checkpoint(state, block=True)
             total = time.perf_counter() - t_start
+            if self.profiler is not None:
+                prof = {f"profile_{k}": v for k, v in self.profiler.summary().items()}
+            else:
+                prof = {}
             return {
+                **prof,
                 "steps": float(self.steps_done),
                 "final_loss": self.losses[-1] if self.losses else float("nan"),
                 "world": float(self._world),

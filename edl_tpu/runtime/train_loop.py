@@ -110,17 +110,23 @@ class Trainer:
         batches: Iterator[Dict[str, np.ndarray]],
         max_steps: Optional[int] = None,
         on_step: Optional[Callable[[int, float], None]] = None,
+        profiler: Optional[Any] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Drive the hot loop host-side: place batch, step, account throughput.
 
         Losses stay on-device until the loop ends so JAX async dispatch can
         pipeline steps; passing ``on_step`` forces a per-step sync (use it for
-        debugging, not benchmarking).
+        debugging, not benchmarking). ``profiler`` (a
+        ``edl_tpu.tools.profiler.StepProfiler``) records per-step wall times
+        without forcing syncs — its step times reflect dispatch cadence, its
+        aggregate throughput is exact.
         """
         losses = []
         n = 0
         t0 = time.perf_counter()
         samples = 0
+        if profiler is not None:
+            profiler.start()
         for batch in batches:
             placed = self.place_batch(batch)
             first = next(iter(batch.values()))
@@ -129,6 +135,8 @@ class Trainer:
             n += 1
             if on_step is not None:
                 on_step(n, float(loss))
+            if profiler is not None:
+                profiler.step(len(first))
             losses.append(loss)
             if max_steps is not None and n >= max_steps:
                 break

@@ -1,0 +1,195 @@
+"""Step-time and trace instrumentation for the training runtime.
+
+The reference's observability is logs only — log15 levels (`cmd/edl/edl.go:26-28`),
+`GLOG_v` on pods, and pass-elapsed prints in examples
+(`example/ctr/ctr/train.py:176`). SURVEY §5 flags that as the bar to clear:
+this module gives the TPU framework first-class step timing and XLA traces.
+
+Three pieces:
+
+- :class:`StepProfiler` — host-side per-step accounting (wall time, samples,
+  rolling throughput, percentiles). Pure data structure; feed it from any
+  loop via :meth:`StepProfiler.step` or wrap an iterator.
+- :func:`trace` — context manager around ``jax.profiler`` that captures an
+  XLA/TPU trace (TensorBoard-loadable) for the enclosed steps.
+- :func:`annotate_step` / :func:`annotation` — named trace spans so the hot
+  loop's phases (place_batch / train_step / checkpoint) are visible in traces.
+
+Device memory introspection (:func:`device_memory_stats`) reports per-device
+HBM in-use/limit where the backend exposes it (TPU does; CPU returns {}).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, TextIO
+
+import jax
+
+__all__ = [
+    "StepProfiler",
+    "StepRecord",
+    "trace",
+    "annotation",
+    "annotate_step",
+    "device_memory_stats",
+]
+
+
+@dataclass
+class StepRecord:
+    """One step's host-side observation."""
+
+    step: int
+    seconds: float
+    samples: int
+    loss: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        d = {"step": self.step, "seconds": round(self.seconds, 6), "samples": self.samples}
+        if self.loss is not None and not math.isnan(self.loss):
+            d["loss"] = self.loss
+        return d
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(math.floor(idx))
+    hi = int(math.ceil(idx))
+    if lo == hi:
+        return sorted_vals[lo]
+    frac = idx - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+class StepProfiler:
+    """Accumulates per-step wall times and derives throughput statistics.
+
+    Skips the first ``warmup`` steps in summaries (they include jit compile,
+    20-40 s on TPU) but still records them, so traces line up with records.
+    A bounded window keeps memory constant on long runs.
+    """
+
+    def __init__(self, warmup: int = 1, window: int = 10_000,
+                 sink: Optional[TextIO] = None):
+        self.warmup = warmup
+        self.window = window
+        self.sink = sink
+        self.records: List[StepRecord] = []
+        self._count = 0
+        self._mark: Optional[float] = None
+
+    # -- feeding ---------------------------------------------------------------
+
+    def start(self) -> None:
+        """Mark the start of a step (optional; ``step`` falls back to the
+        previous step's end)."""
+        self._mark = time.perf_counter()
+
+    def step(self, samples: int, loss: Optional[float] = None) -> StepRecord:
+        """Record one completed step of ``samples`` examples."""
+        now = time.perf_counter()
+        start = self._mark if self._mark is not None else now
+        rec = StepRecord(step=self._count, seconds=now - start,
+                         samples=samples, loss=loss)
+        self._count += 1
+        self._mark = now
+        self.records.append(rec)
+        if len(self.records) > self.window:
+            del self.records[: len(self.records) - self.window]
+        if self.sink is not None:
+            self.sink.write(json.dumps(rec.to_dict()) + "\n")
+            self.sink.flush()
+        return rec
+
+    def wrap(self, batches: Iterator[Dict[str, Any]],
+             batch_size_of=lambda b: len(next(iter(b.values())))) -> Iterator[Dict[str, Any]]:
+        """Yield from ``batches`` while timing each consumer iteration."""
+        self.start()
+        for batch in batches:
+            yield batch
+            self.step(batch_size_of(batch))
+
+    # -- summaries -------------------------------------------------------------
+
+    @property
+    def steady(self) -> List[StepRecord]:
+        return self.records[self.warmup:]
+
+    def summary(self) -> Dict[str, float]:
+        steady = self.steady
+        if not steady:
+            return {"steps": float(len(self.records)), "steady_steps": 0.0}
+        times = sorted(r.seconds for r in steady)
+        total = sum(times)
+        samples = sum(r.samples for r in steady)
+        return {
+            "steps": float(self._count),
+            "steady_steps": float(len(steady)),
+            "samples_per_sec": samples / total if total > 0 else float("inf"),
+            "step_time_mean_s": total / len(steady),
+            "step_time_p50_s": _percentile(times, 0.5),
+            "step_time_p95_s": _percentile(times, 0.95),
+            "step_time_max_s": times[-1],
+        }
+
+
+# -- XLA trace capture ---------------------------------------------------------
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a TensorBoard-loadable device trace of the enclosed block.
+
+    Thin guard over ``jax.profiler.trace``: a backend without profiler support
+    degrades to a no-op instead of failing the training run.
+    """
+    try:
+        cm = jax.profiler.trace(logdir)
+    except Exception:  # pragma: no cover - profiler unavailable
+        yield
+        return
+    try:
+        with cm:
+            yield
+    except Exception:
+        # Never let tracing kill training; re-raise only non-profiler errors
+        # (jax.profiler raises RuntimeError for double-start etc.).
+        raise
+
+
+def annotation(name: str):
+    """Named span visible in captured traces (host + device timeline)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_step(step: int):
+    """Step marker that lets TensorBoard group device ops per training step."""
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+# -- device memory -------------------------------------------------------------
+
+
+def device_memory_stats() -> Dict[str, Dict[str, int]]:
+    """Per-device memory stats where the backend exposes them (TPU HBM).
+
+    Returns {device_id: {bytes_in_use, bytes_limit, ...}}; empty entries are
+    dropped so CPU test runs see {}.
+    """
+    out: Dict[str, Dict[str, int]] = {}
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats:
+            out[str(d.id)] = {k: int(v) for k, v in stats.items()
+                              if isinstance(v, (int, float))}
+    return out

@@ -68,3 +68,15 @@ def test_no_pipe_axis_falls_back():
     got = pipeline_apply(_stage_fn, params, x, mesh)
     want = _sequential(params, x, 1)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_no_pipe_axis_runs_all_stages():
+    """A mesh without a pipe axis (e.g. post-rescale) must still apply every
+    stage sequentially, not silently run only stage 0."""
+    rng = np.random.default_rng(2)
+    params = _stack_params(rng, 4, 8)
+    x = jnp.asarray(rng.standard_normal((16, 8)), jnp.float32)
+    mesh = build_mesh(MeshSpec({"data": 8}))
+    got = pipeline_apply(_stage_fn, params, x, mesh)
+    want = _sequential(params, x, 4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
