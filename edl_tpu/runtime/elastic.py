@@ -196,6 +196,10 @@ class ElasticWorker:
             rescale_t0 = time.perf_counter()
             mesh = self._build_mesh(world)
             trainer = Trainer(self.model, mesh, self.config.trainer)
+            if self.profiler is not None:
+                # The first step on a fresh mesh recompiles (20-40 s on TPU);
+                # keep it out of steady-state summaries.
+                self.profiler.mark_warmup()
             state = self._restore_or_init(trainer)
             first_step_done = False
             last_ckpt_step = int(state.step)

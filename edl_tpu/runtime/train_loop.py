@@ -118,8 +118,10 @@ class Trainer:
         pipeline steps; passing ``on_step`` forces a per-step sync (use it for
         debugging, not benchmarking). ``profiler`` (a
         ``edl_tpu.tools.profiler.StepProfiler``) records per-step wall times
-        without forcing syncs — its step times reflect dispatch cadence, its
-        aggregate throughput is exact.
+        without forcing syncs — its step times reflect dispatch cadence, so
+        its aggregate throughput can over-report slightly on short runs
+        (in-flight tail steps are not awaited); the returned ``metrics``
+        dict's ``samples_per_sec`` is computed after the final sync.
         """
         losses = []
         n = 0

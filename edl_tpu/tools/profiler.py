@@ -48,11 +48,15 @@ class StepRecord:
     seconds: float
     samples: int
     loss: Optional[float] = None
+    #: excluded from steady-state summaries (jit compile / post-rescale).
+    warmup: bool = False
 
     def to_dict(self) -> dict:
         d = {"step": self.step, "seconds": round(self.seconds, 6), "samples": self.samples}
         if self.loss is not None and not math.isnan(self.loss):
             d["loss"] = self.loss
+        if self.warmup:
+            d["warmup"] = True
         return d
 
 
@@ -84,6 +88,7 @@ class StepProfiler:
         self.records: List[StepRecord] = []
         self._count = 0
         self._mark: Optional[float] = None
+        self._pending_warmup = 0
 
     # -- feeding ---------------------------------------------------------------
 
@@ -92,12 +97,20 @@ class StepProfiler:
         previous step's end)."""
         self._mark = time.perf_counter()
 
+    def mark_warmup(self, n: int = 1) -> None:
+        """Flag the next ``n`` steps as warmup — call when the upcoming step
+        will recompile (mesh rebuild after an elastic rescale)."""
+        self._pending_warmup += n
+
     def step(self, samples: int, loss: Optional[float] = None) -> StepRecord:
         """Record one completed step of ``samples`` examples."""
         now = time.perf_counter()
         start = self._mark if self._mark is not None else now
+        is_warmup = self._count < self.warmup or self._pending_warmup > 0
+        if self._pending_warmup > 0:
+            self._pending_warmup -= 1
         rec = StepRecord(step=self._count, seconds=now - start,
-                         samples=samples, loss=loss)
+                         samples=samples, loss=loss, warmup=is_warmup)
         self._count += 1
         self._mark = now
         self.records.append(rec)
@@ -120,7 +133,7 @@ class StepProfiler:
 
     @property
     def steady(self) -> List[StepRecord]:
-        return self.records[self.warmup:]
+        return [r for r in self.records if not r.warmup]
 
     def summary(self) -> Dict[str, float]:
         steady = self.steady
@@ -147,21 +160,26 @@ class StepProfiler:
 def trace(logdir: str):
     """Capture a TensorBoard-loadable device trace of the enclosed block.
 
-    Thin guard over ``jax.profiler.trace``: a backend without profiler support
-    degrades to a no-op instead of failing the training run.
+    Thin guard over ``jax.profiler.trace``: a backend without profiler
+    support (or a profiler already running) degrades to a no-op instead of
+    failing the training run. Profiler errors surface at ``__enter__``/
+    ``__exit__`` — both are guarded; errors from the traced block itself
+    propagate untouched.
     """
+    cm = None
     try:
         cm = jax.profiler.trace(logdir)
-    except Exception:  # pragma: no cover - profiler unavailable
-        yield
-        return
+        cm.__enter__()
+    except Exception:  # pragma: no cover - profiler unavailable/double-start
+        cm = None
     try:
-        with cm:
-            yield
-    except Exception:
-        # Never let tracing kill training; re-raise only non-profiler errors
-        # (jax.profiler raises RuntimeError for double-start etc.).
-        raise
+        yield
+    finally:
+        if cm is not None:
+            try:
+                cm.__exit__(None, None, None)
+            except Exception:  # pragma: no cover
+                pass
 
 
 def annotation(name: str):

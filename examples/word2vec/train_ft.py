@@ -1,0 +1,63 @@
+"""Fault-tolerant elastic word2vec trainer — the reference's flagship demo.
+
+Direct twin of `example/fit_a_line/train_ft.py:24-118`: the reference trains a
+5-gram word-embedding model with etcd-discovered pservers
+(`SGD(is_local=False, pserver_spec=etcd, use_etcd=True)`, `:105-110`) pulling
+chunked tasks from the master queue via `cloud_reader` (`:111-114`). Here the
+sparse-update pserver table is a mesh-sharded `ShardedEmbedding`, discovery is
+the `EDL_*` env protocol pointing at the coordinator, shards are coordinator
+leases, and elasticity is checkpoint-restore rescale.
+
+Runs standalone (no env set): spawns an in-process coordinator and trains the
+whole queue on the local device mesh.
+"""
+
+import json
+import os
+import tempfile
+
+from edl_tpu.launcher.launch import LaunchContext
+from edl_tpu.models import word2vec
+from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
+from edl_tpu.runtime.data import shard_names
+from edl_tpu.runtime.train_loop import TrainerConfig
+from edl_tpu.tools import StepProfiler
+
+
+def main() -> None:
+    ctx = LaunchContext.from_env()
+    model = word2vec.MODEL
+    source = SyntheticShardSource(model, batch_size=512, batches_per_shard=10)
+
+    if os.environ.get("EDL_COORDINATOR_ENDPOINT"):
+        from edl_tpu.launcher.discovery import wait_coordinator
+
+        client = wait_coordinator(ctx.coordinator_endpoint)
+        client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
+    else:  # hermetic demo mode
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        coord = InProcessCoordinator()
+        coord.add_tasks(ctx.data_shards or shard_names("imikolov", 8))
+        client = coord.client("worker-0")
+        ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-w2v-")
+
+    prof = StepProfiler(warmup=1)
+    worker = ElasticWorker(
+        model,
+        client,
+        source,
+        ElasticConfig(
+            checkpoint_dir=ctx.checkpoint_dir,
+            checkpoint_interval=ctx.checkpoint_interval,
+            # ref uses Adam(lr=3e-3) for this model (train_ft.py:102-104)
+            trainer=TrainerConfig(optimizer="adam", learning_rate=3e-3),
+        ),
+        profiler=prof,
+    )
+    metrics = worker.run()
+    print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
+
+
+if __name__ == "__main__":
+    main()

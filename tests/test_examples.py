@@ -1,0 +1,56 @@
+"""Example scripts run end-to-end hermetically (standalone demo modes)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_example(*argv, timeout=300):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.run(
+        [sys.executable, *argv], capture_output=True, text=True,
+        timeout=timeout, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, f"stderr:\n{proc.stderr[-2000:]}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def test_word2vec_train_ft_standalone():
+    out = run_example("examples/word2vec/train_ft.py")
+    assert out["steps"] == 80.0
+    assert out["final_loss"] < 7.7  # below uniform log(2074)
+    assert out["profile_steady_steps"] == 79.0
+
+
+def test_mnist_train_then_infer(tmp_path):
+    model_dir = str(tmp_path / "ck")
+    out = run_example("examples/mnist/train.py", "train",
+                      "--steps", "15", "--model-dir", model_dir)
+    assert out["steps"] == 15.0
+    inf = run_example("examples/mnist/train.py", "infer", "--model-dir", model_dir)
+    assert inf["step"] == 15
+    assert inf["accuracy"] > 0.9  # synthetic quadrant digits are separable
+
+
+@pytest.mark.parametrize("yaml_path", [
+    "examples/fit_a_line/job.yaml",
+    "examples/ctr/job.yaml",
+    "examples/word2vec/job.yaml",
+    "examples/mnist/job.yaml",
+])
+def test_job_yamls_pass_admission(yaml_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "edl_tpu", "validate", "-f", yaml_path],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]

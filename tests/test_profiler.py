@@ -51,6 +51,29 @@ def test_step_profiler_window_bounds_memory():
     assert p.summary()["steps"] == 50.0
 
 
+def test_window_eviction_does_not_misclassify_steady():
+    """Warmup is a per-record flag, not a list position: after the warmup
+    record is evicted by the window, no steady record is dropped."""
+    p = StepProfiler(warmup=1, window=5)
+    p.start()
+    for _ in range(20):
+        p.step(samples=1)
+    assert len(p.steady) == 5  # all surviving records are steady
+    assert p.summary()["steady_steps"] == 5.0
+
+
+def test_mark_warmup_flags_recompile_steps():
+    p = StepProfiler(warmup=0)
+    p.start()
+    p.step(samples=1)
+    p.mark_warmup()  # e.g. mesh rebuilt after rescale
+    p.step(samples=1)
+    p.step(samples=1)
+    flags = [r.warmup for r in p.records]
+    assert flags == [False, True, False]
+    assert p.summary()["steady_steps"] == 2.0
+
+
 def test_wrap_iterator_times_consumer():
     p = StepProfiler(warmup=0)
     data = [{"x": np.zeros((4, 2))} for _ in range(3)]
