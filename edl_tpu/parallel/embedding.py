@@ -35,6 +35,69 @@ def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult
 
 
+# -- dedup'd gather: sparse-gradient aggregation (opt-in) ----------------------
+#
+# The backward of a plain ``table[ids]`` is a scatter-add with duplicate
+# indices. This gather's custom vjp pre-combines duplicate ids (sort +
+# sorted segment-sum) so the final scatter sees each row at most once and
+# can assert ``unique_indices`` — the moral equivalent of the reference
+# pserver's aggregated sparse-row update (`docker/paddle_k8s:7-9`).
+#
+# Measured on v5e with CTR shapes (8192x26 zipf ids into a 1e6x10 table),
+# XLA's native scatter-add beat this path (11.6 ms vs 18.6 ms: the 213k-key
+# sort dominates), so the lookup paths below use the plain gather; this
+# stays available for workloads with far heavier id duplication (it wins
+# when duplicates per step >> unique rows, e.g. tiny vocabularies).
+
+
+@jax.custom_vjp
+def dedup_gather(table: jax.Array, flat_ids: jax.Array) -> jax.Array:
+    """``table[flat_ids]`` whose backward aggregates duplicate ids before
+    scattering. ``flat_ids``: 1-D non-negative int array."""
+    return table[flat_ids]
+
+
+def _dedup_gather_fwd(table, flat_ids):
+    return table[flat_ids], (table, flat_ids)
+
+
+def _dedup_gather_bwd(res, g):
+    table, flat_ids = res
+    # Canonicalize: the sentinel logic below needs a signed dtype wide enough
+    # for table.shape[0] + n (segment_max's identity for unsigned ints is 0,
+    # which would collide with real row 0).
+    flat_ids = flat_ids.astype(jnp.int32)
+    n = flat_ids.shape[0]
+    if n == 0:
+        return jnp.zeros_like(table), None
+    sorted_ids, perm = jax.lax.sort_key_val(
+        flat_ids, jnp.arange(n, dtype=jnp.int32)
+    )
+    g_sorted = jnp.take(g, perm, axis=0)
+    starts = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_ids[1:] != sorted_ids[:-1]]
+    )
+    seg = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    uniq_grad = jax.ops.segment_sum(
+        g_sorted, seg, num_segments=n, indices_are_sorted=True
+    )
+    uniq_ids = jax.ops.segment_max(
+        sorted_ids, seg, num_segments=n, indices_are_sorted=True
+    )
+    # Empty segments hold segment_max's identity (int32 min); remap each to a
+    # distinct out-of-range slot so `unique_indices` stays honest and `drop`
+    # discards them.
+    sentinel = table.shape[0] + jnp.arange(n, dtype=uniq_ids.dtype)
+    uniq_ids = jnp.where(uniq_ids < 0, sentinel, uniq_ids)
+    dtable = jnp.zeros_like(table).at[uniq_ids].add(
+        uniq_grad.astype(table.dtype), mode="drop", unique_indices=True
+    )
+    return dtable, None
+
+
+dedup_gather.defvjp(_dedup_gather_fwd, _dedup_gather_bwd)
+
+
 @dataclass(frozen=True)
 class ShardedEmbedding:
     """Config + functional init/apply for one row-sharded table.

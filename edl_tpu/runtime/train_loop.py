@@ -41,6 +41,9 @@ class TrainerConfig:
     grad_clip_norm: float = 0.0
     batch_axis: str = "data"
     seed: int = 0
+    #: compact host->device batch transport (bf16 floats, u8/u24 ints; see
+    #: edl_tpu.runtime.wire). Decode happens inside the jitted step.
+    wire_transport: bool = False
 
 
 def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -79,7 +82,10 @@ class Trainer:
 
         # Input shardings flow from the state/batch placements; XLA SPMD
         # inserts the data-axis psum for grads. Donation reuses HBM buffers.
+        self._step_fn = _step
         self._jit_step = jax.jit(_step, donate_argnums=(0,))
+        self._codec = None  # negotiated on first place_batch when wire_transport
+        self._jit_step_wire = None
 
     # -- state -----------------------------------------------------------------
 
@@ -94,6 +100,17 @@ class Trainer:
     # -- stepping --------------------------------------------------------------
 
     def place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        if self.config.wire_transport:
+            if self._codec is None:
+                from edl_tpu.runtime.wire import WireCodec
+
+                self._codec = WireCodec.infer(batch)
+                codec = self._codec
+                self._jit_step_wire = jax.jit(
+                    lambda state, wired: self._step_fn(state, codec.decode(wired)),
+                    donate_argnums=(0,),
+                )
+            batch = self._codec.encode(batch)
         specs = (
             self.model.batch_spec(self.mesh)
             if self.model.batch_spec is not None
@@ -102,6 +119,8 @@ class Trainer:
         return shard_batch(batch, self.mesh, self.config.batch_axis, specs=specs)
 
     def train_step(self, state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, jax.Array]:
+        if self._codec is not None and self._codec.is_encoded(batch):
+            return self._jit_step_wire(state, batch)
         return self._jit_step(state, batch)
 
     def run(

@@ -1,0 +1,132 @@
+"""Compact host->device wire format for training batches.
+
+The reference streams minibatches to trainers from recordio files on local
+disk (`example/ctr/ctr/train.py:221-227` downloads its shard first), so its
+input path is never the bottleneck. On TPU the host->device hop is often the
+narrowest link in the system (PCIe on a TPU VM; far less over remote
+tunnels), so the framework ships a transport codec: batches cross the wire in
+the smallest dtype that preserves training semantics and are decoded on
+device inside the jitted step, where the casts fuse into the first consumers
+for free.
+
+Encodings (chosen per key from an example batch):
+
+- ``bf16``: float32/64 -> bfloat16. The models' matmuls already run bf16 on
+  the MXU, so feature precision beyond bf16 never reaches the math.
+- ``u8``:  non-negative ints < 256 (labels, small categoricals) -> uint8.
+- ``u24``: non-negative ints < 2^24 (hashed sparse ids; CTR's vocab is
+  1e6+1) -> 3 little-endian bytes, reassembled with shifts on device.
+- ``raw``: anything else passes through.
+
+``encode`` validates every batch against the chosen encoding (a later batch
+overflowing the example's range raises instead of corrupting), so inference
+from one example batch is safe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+from ml_dtypes import bfloat16 as np_bfloat16
+
+__all__ = ["WireCodec", "WireOverflowError"]
+
+_U24_MAX = (1 << 24) - 1
+
+
+class WireOverflowError(ValueError):
+    """A batch value exceeds the range of its negotiated wire encoding."""
+
+
+@dataclass(frozen=True)
+class _KeyCodec:
+    encoding: str  # "raw" | "bf16" | "u8" | "u24"
+    dtype: np.dtype  # original host dtype (decode target modulo width)
+
+
+class WireCodec:
+    """Per-key transport encodings inferred once, applied per batch."""
+
+    def __init__(self, keys: Dict[str, _KeyCodec]):
+        self.keys = keys
+
+    # -- inference -------------------------------------------------------------
+
+    @classmethod
+    def infer(cls, example: Dict[str, np.ndarray]) -> "WireCodec":
+        keys: Dict[str, _KeyCodec] = {}
+        for name, arr in example.items():
+            a = np.asarray(arr)
+            if a.dtype in (np.float32, np.float64):
+                keys[name] = _KeyCodec("bf16", a.dtype)
+            elif np.issubdtype(a.dtype, np.integer) and a.size:
+                lo, hi = int(a.min()), int(a.max())
+                if lo >= 0 and hi < 256:
+                    keys[name] = _KeyCodec("u8", a.dtype)
+                elif lo >= 0 and hi <= _U24_MAX:
+                    keys[name] = _KeyCodec("u24", a.dtype)
+                else:
+                    keys[name] = _KeyCodec("raw", a.dtype)
+            else:
+                keys[name] = _KeyCodec("raw", a.dtype)
+        return cls(keys)
+
+    # -- host side -------------------------------------------------------------
+
+    def encode(self, batch: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for name, arr in batch.items():
+            kc = self.keys.get(name)
+            a = np.asarray(arr)
+            if kc is None or kc.encoding == "raw":
+                out[name] = a
+            elif kc.encoding == "bf16":
+                out[name] = a.astype(np_bfloat16)
+            elif kc.encoding == "u8":
+                if a.size and (a.min() < 0 or a.max() > 255):
+                    raise WireOverflowError(f"{name}: value outside u8 range")
+                out[name] = a.astype(np.uint8)
+            elif kc.encoding == "u24":
+                if a.size and (a.min() < 0 or a.max() > _U24_MAX):
+                    raise WireOverflowError(f"{name}: value outside u24 range")
+                le = np.ascontiguousarray(a.astype("<i4"))
+                out[name] = le.view(np.uint8).reshape(a.shape + (4,))[..., :3].copy()
+            else:  # pragma: no cover
+                raise ValueError(f"unknown encoding {kc.encoding}")
+        return out
+
+    # -- device side (jit-traceable) -------------------------------------------
+
+    def decode(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, arr in batch.items():
+            kc = self.keys.get(name)
+            if kc is None or kc.encoding == "raw":
+                out[name] = arr
+            elif kc.encoding == "bf16":
+                out[name] = arr.astype(jnp.dtype(kc.dtype))
+            elif kc.encoding == "u8":
+                out[name] = arr.astype(jnp.dtype(kc.dtype))
+            elif kc.encoding == "u24":
+                b = arr.astype(jnp.int32)
+                v = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+                out[name] = v.astype(jnp.dtype(kc.dtype))
+            else:  # pragma: no cover
+                raise ValueError(f"unknown encoding {kc.encoding}")
+        return out
+
+    def is_encoded(self, batch: Dict[str, Any]) -> bool:
+        """True if ``batch`` looks wire-encoded (used to route jit variants)."""
+        for name, kc in self.keys.items():
+            if name in batch and kc.encoding != "raw":
+                enc = batch[name].dtype
+                if kc.encoding == "bf16":
+                    return str(enc) == "bfloat16"
+                return enc == np.uint8
+        return False
+
+    def wire_bytes(self, batch: Dict[str, np.ndarray]) -> int:
+        return sum(int(np.asarray(v).nbytes) for v in self.encode(batch).values())
