@@ -167,9 +167,26 @@ def start_coordinator(ctx: LaunchContext, block: bool = True):
         server.stop()
 
 
-def start_trainer(ctx: LaunchContext, extra_env: Optional[Dict[str, str]] = None) -> int:
+#: entry exit code meaning "world size changed: relaunch me at the new one".
+#: A multi-host worker cannot rebuild its jax.distributed world in-process
+#: (world size is fixed at initialize), so it checkpoints and exits with this
+#: code; the launcher restarts the entry, which re-initializes at the new
+#: world and restores. 75 = EX_TEMPFAIL ("temporary failure, retry").
+RESCALE_EXIT_CODE = 75
+
+
+def start_trainer(
+    ctx: LaunchContext,
+    extra_env: Optional[Dict[str, str]] = None,
+    max_rescale_restarts: int = 64,
+) -> int:
     """Gate, wait, exec ENTRY; account failures. Returns the child's exit code
-    (ref: start_new_trainer, `docker/paddle_k8s:121-143`)."""
+    (ref: start_new_trainer, `docker/paddle_k8s:121-143`).
+
+    An entry exiting with RESCALE_EXIT_CODE is relaunched in place (warm
+    restart: the pod, its cached compilation state, and its data stay put —
+    only the JAX runtime re-initializes), without touching the job-wide
+    failure budget."""
     if not ctx.entry:
         raise ValueError("EDL_ENTRY is required for start_trainer")
     client = wait_coordinator(ctx.coordinator_endpoint)
@@ -183,8 +200,12 @@ def start_trainer(ctx: LaunchContext, extra_env: Optional[Dict[str, str]] = None
     env = dict(os.environ)
     env.update(extra_env or {})
     cwd = ctx.workspace or None
-    log.info("exec: %s (cwd=%s)", ctx.entry, cwd or ".")
-    proc = subprocess.run(shlex.split(ctx.entry), env=env, cwd=cwd)
+    for restart in range(max_rescale_restarts + 1):
+        log.info("exec: %s (cwd=%s, restart=%d)", ctx.entry, cwd or ".", restart)
+        proc = subprocess.run(shlex.split(ctx.entry), env=env, cwd=cwd)
+        if proc.returncode != RESCALE_EXIT_CODE:
+            break
+        log.info("entry requested rescale restart (exit %d)", RESCALE_EXIT_CODE)
     reason = map_exit_code(proc.returncode)
     _write_termination_log(ctx, reason)
     if proc.returncode != 0:

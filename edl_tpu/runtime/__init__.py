@@ -9,10 +9,13 @@ orbax async checkpoints, and checkpoint-restore mesh rescale.
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.data import LeaseReader, SyntheticShardSource, shard_names
+from edl_tpu.runtime.distributed import DistributedIdentity, distributed_init
 from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
+from edl_tpu.runtime.wire import WireCodec
 
 __all__ = [
     "Checkpointer",
+    "DistributedIdentity",
     "ElasticConfig",
     "ElasticWorker",
     "LeaseReader",
@@ -21,7 +24,9 @@ __all__ = [
     "TrainState",
     "Trainer",
     "TrainerConfig",
+    "WireCodec",
     "abstract_like",
+    "distributed_init",
     "live_state_specs",
     "shard_names",
 ]

@@ -50,6 +50,14 @@ class ElasticConfig:
     #: (the checkpoint is already durable, latecomers restore from it).
     rescale_barrier_timeout: float = 60.0
     batch_axis: str = "data"
+    #: multi-host mode: on a membership change, checkpoint durably and exit
+    #: the process with RESCALE_EXIT_CODE instead of rebuilding in-process.
+    #: jax.distributed's world size is fixed at initialize, so a multi-host
+    #: worker must restart to join the new world; the pod launcher
+    #: (launcher.launch.start_trainer) relaunches the entry, which re-runs
+    #: distributed_init and restores from the checkpoint. Single-host jobs
+    #: (the default) re-slice local devices without restarting.
+    restart_on_rescale: bool = False
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
 
@@ -252,6 +260,14 @@ class ElasticWorker:
                 # Membership changed: make state durable, then rendezvous at
                 # the top of the loop and rebuild at the agreed world size.
                 self._checkpoint(state, block=True)
+                if self.config.restart_on_rescale:
+                    from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
+
+                    log.info(
+                        "membership epoch moved; exiting %d for a warm "
+                        "restart into the new world", RESCALE_EXIT_CODE,
+                    )
+                    raise SystemExit(RESCALE_EXIT_CODE)
                 self._prev_world = world
                 info = self.client.register()  # refresh observed epoch/world
                 self._epoch = info["epoch"]

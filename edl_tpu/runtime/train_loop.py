@@ -99,18 +99,30 @@ class Trainer:
 
     # -- stepping --------------------------------------------------------------
 
+    def _rebuild_wire_jit(self) -> None:
+        codec = self._codec
+        self._jit_step_wire = jax.jit(
+            lambda state, wired: self._step_fn(state, codec.decode(wired)),
+            donate_argnums=(0,),
+        )
+
     def place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         if self.config.wire_transport:
-            if self._codec is None:
-                from edl_tpu.runtime.wire import WireCodec
+            from edl_tpu.runtime.wire import WireCodec, WireOverflowError
 
+            if self._codec is None:
                 self._codec = WireCodec.infer(batch)
-                codec = self._codec
-                self._jit_step_wire = jax.jit(
-                    lambda state, wired: self._step_fn(state, codec.decode(wired)),
-                    donate_argnums=(0,),
-                )
-            batch = self._codec.encode(batch)
+                self._rebuild_wire_jit()
+            while True:
+                try:
+                    batch = self._codec.encode(batch)
+                    break
+                except WireOverflowError as e:
+                    # A later batch exceeded the example batch's range: widen
+                    # that key's encoding and re-jit (bounded — at most two
+                    # widenings per key, then it is raw).
+                    self._codec = self._codec.widen(e.key)
+                    self._rebuild_wire_jit()
         specs = (
             self.model.batch_spec(self.mesh)
             if self.model.batch_spec is not None

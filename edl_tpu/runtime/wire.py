@@ -40,6 +40,10 @@ _U24_MAX = (1 << 24) - 1
 class WireOverflowError(ValueError):
     """A batch value exceeds the range of its negotiated wire encoding."""
 
+    def __init__(self, key: str, message: str):
+        super().__init__(message)
+        self.key = key
+
 
 @dataclass(frozen=True)
 class _KeyCodec:
@@ -87,11 +91,11 @@ class WireCodec:
                 out[name] = a.astype(np_bfloat16)
             elif kc.encoding == "u8":
                 if a.size and (a.min() < 0 or a.max() > 255):
-                    raise WireOverflowError(f"{name}: value outside u8 range")
+                    raise WireOverflowError(name, f"{name}: value outside u8 range")
                 out[name] = a.astype(np.uint8)
             elif kc.encoding == "u24":
                 if a.size and (a.min() < 0 or a.max() > _U24_MAX):
-                    raise WireOverflowError(f"{name}: value outside u24 range")
+                    raise WireOverflowError(name, f"{name}: value outside u24 range")
                 le = np.ascontiguousarray(a.astype("<i4"))
                 out[name] = le.view(np.uint8).reshape(a.shape + (4,))[..., :3].copy()
             else:  # pragma: no cover
@@ -117,6 +121,20 @@ class WireCodec:
             else:  # pragma: no cover
                 raise ValueError(f"unknown encoding {kc.encoding}")
         return out
+
+    def widen(self, key: str) -> "WireCodec":
+        """Return a codec with ``key``'s int encoding one step wider
+        (u8 -> u24 -> raw). Used to self-heal after a WireOverflowError when a
+        later batch exceeds the example batch's range; float encodings never
+        overflow. Raises KeyError for keys that cannot widen further."""
+        kc = self.keys[key]
+        if kc.encoding == "u8":
+            wider = _KeyCodec("u24", kc.dtype)
+        elif kc.encoding == "u24":
+            wider = _KeyCodec("raw", kc.dtype)
+        else:
+            raise KeyError(f"{key}: encoding {kc.encoding!r} cannot widen")
+        return WireCodec({**self.keys, key: wider})
 
     def is_encoded(self, batch: Dict[str, Any]) -> bool:
         """True if ``batch`` looks wire-encoded (used to route jit variants)."""

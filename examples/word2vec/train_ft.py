@@ -31,9 +31,11 @@ def main() -> None:
 
     if os.environ.get("EDL_COORDINATOR_ENDPOINT"):
         from edl_tpu.launcher.discovery import wait_coordinator
+        from edl_tpu.runtime.distributed import distributed_init
 
         client = wait_coordinator(ctx.coordinator_endpoint)
         client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
+        distributed_init(ctx, client)  # multi-host mesh bring-up (no-op if 1 proc)
     else:  # hermetic demo mode
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
