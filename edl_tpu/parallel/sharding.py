@@ -41,18 +41,29 @@ def shard_batch(
     NamedSharding makes the global array. Replaces the reference's
     per-trainer file-shard reader (`example/fit_a_line/fluid/common.py:24-40`,
     `idx % trainers == trainer_id`).
+
+    Multi-process (`jax.distributed` initialized): each process passes its
+    LOCAL slice and `jax.make_array_from_process_local_data` assembles the
+    global array — no host ever holds the full batch.
     """
+    if jax.process_count() > 1:
+        def place(a, sharding):
+            import numpy as np
+
+            return jax.make_array_from_process_local_data(sharding, np.asarray(a))
+    else:
+        def place(a, sharding):
+            return jax.device_put(jnp.asarray(a), sharding)
+
     if specs is not None:
         return jax.tree_util.tree_map(
-            lambda a, s: jax.device_put(jnp.asarray(a), NamedSharding(mesh, s)),
+            lambda a, s: place(a, NamedSharding(mesh, s)),
             batch,
             specs,
             is_leaf=lambda x: isinstance(x, P),
         )
     sharding = batch_sharding(mesh, axis)
-    return jax.tree_util.tree_map(
-        lambda x: jax.device_put(jnp.asarray(x), sharding), batch
-    )
+    return jax.tree_util.tree_map(lambda x: place(x, sharding), batch)
 
 
 def global_batch_size(local_batch: int, mesh: Mesh, axis: str = "data") -> int:

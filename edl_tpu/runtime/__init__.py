@@ -11,6 +11,7 @@ from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_s
 from edl_tpu.runtime.data import LeaseReader, SyntheticShardSource, shard_names
 from edl_tpu.runtime.distributed import DistributedIdentity, distributed_init
 from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
+from edl_tpu.runtime.multihost import MultiHostWorker
 from edl_tpu.runtime.wire import WireCodec
 
 __all__ = [
@@ -19,6 +20,7 @@ __all__ = [
     "ElasticConfig",
     "ElasticWorker",
     "LeaseReader",
+    "MultiHostWorker",
     "RescaleEvent",
     "SyntheticShardSource",
     "TrainState",

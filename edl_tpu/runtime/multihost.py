@@ -1,0 +1,271 @@
+"""Multi-host SPMD elastic training: lockstep rounds over one global mesh.
+
+Single-host elasticity (`edl_tpu.runtime.elastic.ElasticWorker`) lets each
+worker lease shards independently — fine when each worker owns its own mesh.
+A multi-host job is ONE mesh spanning every process, so every process must
+execute the same jitted step the same number of times (each step is a global
+collective); independent leasing would deadlock the stragglers.
+
+Protocol (the TPU-native reshape of the reference master's task queue,
+`docker/paddle_k8s:26-32` — still at-least-once leases, but consumed in
+lockstep):
+
+- rank 0 is the decision-maker: each ROUND it checks the membership epoch
+  and leases ``world`` shards, then broadcasts the round plan through the
+  coordinator KV under an (epoch, round)-scoped key;
+- every rank polls that exact key, trains its assigned shard's batches
+  (shards yield identical batch counts by construction, so steps align),
+  and assembles its local slice into global arrays
+  (`Trainer.place_batch` -> ``jax.make_array_from_process_local_data``);
+- tail rounds with fewer shards than ranks replicate the remainder across
+  ranks (``tasks[r % len]``) so the queue drains without breaking lockstep;
+- **completion lags the checkpoint**: rank 0 holds consumed shards' leases
+  until a collective checkpoint covers them, then marks them complete. An
+  interrupted incarnation therefore replays exactly the shards whose
+  updates the restored checkpoint lacks (true at-least-once — the same
+  guarantee the reference gets from pserver-held state + lease requeue);
+- on an epoch change (or a poll timeout — e.g. rank 0 died) every rank
+  exits ``RESCALE_EXIT_CODE`` WITHOUT saving: a collective orbax save
+  cannot complete if any peer is already gone, and the completion lag
+  makes the last periodic checkpoint a consistent restore point. The pod
+  launcher warm-restarts the entry, which re-runs ``distributed_init``
+  and comes back at the new world size.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Dict, List, Optional
+
+import jax
+from jax.sharding import Mesh
+
+from edl_tpu.models.base import Model
+from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
+from edl_tpu.runtime.elastic import ElasticConfig
+from edl_tpu.runtime.train_loop import Trainer, TrainState
+
+log = logging.getLogger("edl_tpu.multihost")
+
+#: KV key template for round plans; epoch-scoping keeps incarnations apart.
+ROUND_KEY = "edl/mh_round/{epoch}/{round}"
+
+
+class MultiHostWorker:
+    """One process's share of a lockstep multi-host elastic job.
+
+    Requires ``jax.distributed`` to be initialized first
+    (`edl_tpu.runtime.distributed.distributed_init`); ranks here are
+    ``jax.process_index()``, which distributed_init derived from the same
+    coordinator registration this worker holds.
+
+    Sizing note: uncommitted leases are not renewed, so if a checkpoint
+    interval takes longer than the coordinator's task-lease time (16 s
+    default) some shards expire, requeue, and train twice before their
+    re-lease commits — correct (at-least-once) but wasteful. Pick
+    ``checkpoint_interval`` so an interval's wall time stays under the
+    lease time, or raise ``--task-lease-sec``.
+    """
+
+    def __init__(
+        self,
+        model: Model,
+        client,
+        source,  # object with .read(shard) -> Iterator[host batch]
+        config: ElasticConfig,
+        mesh_axes: Optional[Dict[str, int]] = None,
+        profiler=None,
+    ):
+        if not config.checkpoint_dir:
+            raise ValueError("ElasticConfig.checkpoint_dir is required")
+        self.model = model
+        self.client = client
+        self.source = source
+        self.config = config
+        self.mesh_axes = mesh_axes
+        self.profiler = profiler
+        self.ckpt = Checkpointer(config.checkpoint_dir)
+        self.steps_done = 0
+        self.losses: List[float] = []
+        #: rank 0 only: shards consumed since the last durable checkpoint —
+        #: their leases are held open until a checkpoint covers them.
+        self._uncommitted: List[str] = []
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _build_mesh(self) -> Mesh:
+        devices = jax.devices()  # global: every process's chips
+        axes = dict(self.mesh_axes or {})
+        fixed = 1
+        for size in axes.values():
+            fixed *= size
+        if len(devices) % fixed != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {axes}"
+            )
+        axes["data"] = len(devices) // fixed
+        return build_mesh(MeshSpec(axes), devices)
+
+    def _restore_or_init(self, trainer: Trainer) -> TrainState:
+        fresh = trainer.init_state()
+        if self.ckpt.latest_step() is None:
+            return fresh
+        state = self.ckpt.restore(
+            abstract_like(fresh), trainer.mesh, live_state_specs(fresh)
+        )
+        log.info("restored step=%s onto %d-process mesh",
+                 self.ckpt.latest_step(), jax.process_count())
+        return state
+
+    def _exit_for_restart(self) -> None:
+        """No save here: a collective orbax save hangs if any peer is gone,
+        and completion lag guarantees the last periodic checkpoint is a
+        consistent restore point (uncommitted shards' leases expire and
+        requeue for replay)."""
+        from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
+
+        log.info("epoch moved; exiting %d for warm restart", RESCALE_EXIT_CODE)
+        raise SystemExit(RESCALE_EXIT_CODE)
+
+    # -- round plan exchange ---------------------------------------------------
+
+    def _publish_round(self, epoch: int, rnd: int, world: int) -> dict:
+        """Rank 0: lease up to ``world`` shards and broadcast the plan.
+
+        Emits ``{"ckpt": true}`` instead of shards when the uncommitted
+        backlog must be made durable first — either the queue drained down
+        to our own held leases (flush before declaring exhausted) or the
+        periodic interval elapsed."""
+        hb = self.client.heartbeat()
+        if not hb.get("ok"):
+            hb = self.client.register()
+        if int(hb["epoch"]) != epoch:
+            msg = {"stop": "rescale"}
+        else:
+            tasks = []
+            for _ in range(world):
+                task = self.client.acquire_task()
+                if task is None:
+                    break
+                tasks.append(task)
+            if not tasks:
+                st = self.client.status()
+                queued = int(st.get("queued", 0))
+                leased = int(st.get("leased", 0))
+                if self._uncommitted:
+                    # Tail flush: checkpoint, then complete our held leases.
+                    msg = {"ckpt": True}
+                elif queued == 0 and leased == 0:
+                    msg = {"stop": "exhausted"}
+                else:
+                    # Another incarnation's lease has not expired yet.
+                    msg = {"stop": "wait"}
+            else:
+                msg = {"tasks": tasks}
+        self.client.kv_put(ROUND_KEY.format(epoch=epoch, round=rnd), json.dumps(msg))
+        # Round plans are read only at their own round index: GC the previous
+        # key so a long job does not grow the coordinator KV unboundedly.
+        if rnd > 0:
+            self.client.kv_del(ROUND_KEY.format(epoch=epoch, round=rnd - 1))
+        return msg
+
+    def _poll_round(self, epoch: int, rnd: int, timeout: float) -> dict:
+        """Ranks > 0: block on the round key; a timeout means rank 0 is gone
+        (or membership is thrashing) — treat as a rescale."""
+        key = ROUND_KEY.format(epoch=epoch, round=rnd)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = self.client.kv_get(key)
+            if raw:
+                return json.loads(raw)
+            self.client.heartbeat()
+            time.sleep(0.05)
+        log.warning("round %d plan never arrived; assuming rescale", rnd)
+        return {"stop": "rescale"}
+
+    # -- main loop -------------------------------------------------------------
+
+    def run(self, max_rounds: int = 1_000_000) -> Dict[str, float]:
+        rank = jax.process_index()
+        world = jax.process_count()
+        info = self.client.register()
+        epoch = int(info["epoch"])
+
+        mesh = self._build_mesh()
+        trainer = Trainer(self.model, mesh, self.config.trainer)
+        if self.profiler is not None:
+            self.profiler.mark_warmup()
+        state = self._restore_or_init(trainer)
+        last_ckpt_step = int(state.step)
+        t_start = time.perf_counter()
+
+        def checkpoint_and_commit() -> None:
+            """Collective save (all ranks reach this in the same round), then
+            rank 0 completes the shards that checkpoint now covers."""
+            nonlocal last_ckpt_step
+            self.ckpt.save(int(state.step), state)
+            self.ckpt.wait()
+            last_ckpt_step = int(state.step)
+            if rank == 0:
+                for t in self._uncommitted:
+                    self.client.complete_task(t)
+                self._uncommitted.clear()
+
+        if self.profiler is not None:
+            self.profiler.start()
+        for rnd in range(max_rounds):
+            if rank == 0:
+                msg = self._publish_round(epoch, rnd, world)
+            else:
+                msg = self._poll_round(
+                    epoch, rnd, timeout=self.config.rescale_barrier_timeout
+                )
+
+            stop = msg.get("stop")
+            if stop == "rescale":
+                self._exit_for_restart()
+            if stop == "exhausted":
+                break
+            if stop == "wait":
+                # Queue empty but leases outstanding (e.g. a previous
+                # incarnation's lease has not expired yet): idle this round.
+                time.sleep(0.2)
+                continue
+            if msg.get("ckpt"):
+                checkpoint_and_commit()
+                continue
+
+            tasks = msg["tasks"]
+            shard = tasks[rank % len(tasks)]  # tail rounds replicate remainder
+            for batch in self.source.read(shard):
+                placed = trainer.place_batch(batch)
+                state, loss = trainer.train_step(state, placed)
+                self.steps_done += 1
+                self.losses.append(float(loss))
+                if self.profiler is not None:
+                    self.profiler.step(len(next(iter(batch.values()))))
+            if rank == 0:
+                self._uncommitted.extend(dict.fromkeys(tasks))  # dedup tail dups
+            if int(state.step) - last_ckpt_step >= self.config.checkpoint_interval:
+                # Deterministic across ranks (lockstep step counter), so every
+                # process enters the collective save together.
+                checkpoint_and_commit()
+
+        # drained: final collective checkpoint covers any stragglers
+        checkpoint_and_commit()
+        prof = (
+            {f"profile_{k}": v for k, v in self.profiler.summary().items()}
+            if self.profiler is not None
+            else {}
+        )
+        return {
+            **prof,
+            "steps": float(self.steps_done),
+            "final_loss": self.losses[-1] if self.losses else float("nan"),
+            "world": float(world),
+            "rank": float(rank),
+            "seconds": time.perf_counter() - t_start,
+        }

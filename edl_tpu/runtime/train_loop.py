@@ -107,7 +107,20 @@ class Trainer:
         )
 
     def place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-        if self.config.wire_transport:
+        if self.config.wire_transport and jax.process_count() > 1:
+            # Every process must jit the IDENTICAL program; per-process codec
+            # inference (and widening) from local batches would diverge them
+            # and mis-pair collectives. Until codec negotiation is broadcast
+            # through the coordinator, multi-process jobs ship raw batches.
+            if not getattr(self, "_warned_wire_multiproc", False):
+                self._warned_wire_multiproc = True
+                import logging
+
+                logging.getLogger("edl_tpu.trainer").warning(
+                    "wire_transport disabled: multi-process jobs need a "
+                    "globally agreed codec"
+                )
+        elif self.config.wire_transport:
             from edl_tpu.runtime.wire import WireCodec, WireOverflowError
 
             if self._codec is None:

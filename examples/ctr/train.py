@@ -41,13 +41,14 @@ def main() -> None:
     source = SyntheticShardSource(model, batch_size=args.batch_size,
                                   batches_per_shard=args.batches_per_shard)
 
+    ident = None
     if os.environ.get("EDL_COORDINATOR_ENDPOINT"):  # cloud mode (ref :192-203)
         from edl_tpu.launcher.discovery import wait_coordinator
         from edl_tpu.runtime.distributed import distributed_init
 
         client = wait_coordinator(ctx.coordinator_endpoint)
         client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
-        distributed_init(ctx, client)  # multi-host mesh bring-up (no-op if 1 proc)
+        ident = distributed_init(ctx, client)  # multi-host bring-up (None if 1 proc)
     else:  # local twin
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
@@ -56,18 +57,22 @@ def main() -> None:
         client = coord.client("worker-0")
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-ctr-")
 
-    worker = ElasticWorker(
-        model,
-        client,
-        source,
-        ElasticConfig(
-            checkpoint_dir=ctx.checkpoint_dir,
-            checkpoint_interval=ctx.checkpoint_interval,
-            trainer=TrainerConfig(optimizer="adagrad",
-                                  learning_rate=args.learning_rate),
-        ),
-        mesh_axes={k: v for k, v in ctx.mesh_axes.items() if k != "data"} or None,
+    cfg = ElasticConfig(
+        checkpoint_dir=ctx.checkpoint_dir,
+        checkpoint_interval=ctx.checkpoint_interval,
+        trainer=TrainerConfig(optimizer="adagrad",
+                              learning_rate=args.learning_rate),
     )
+    mesh_axes = {k: v for k, v in ctx.mesh_axes.items() if k != "data"} or None
+    if ident is not None:
+        # Multi-host world: one global mesh, lockstep rounds; rescale is a
+        # launcher warm restart (independent leasing would deadlock the
+        # fixed-size jax.distributed world).
+        from edl_tpu.runtime import MultiHostWorker
+
+        worker = MultiHostWorker(model, client, source, cfg, mesh_axes=mesh_axes)
+    else:
+        worker = ElasticWorker(model, client, source, cfg, mesh_axes=mesh_axes)
     metrics = worker.run()
     print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
 

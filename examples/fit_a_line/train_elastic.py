@@ -25,13 +25,14 @@ def main() -> None:
     model = fit_a_line.MODEL
     source = SyntheticShardSource(model, batch_size=256, batches_per_shard=20)
 
+    ident = None
     if os.environ.get("EDL_COORDINATOR_ENDPOINT"):
         from edl_tpu.launcher.discovery import wait_coordinator
         from edl_tpu.runtime.distributed import distributed_init
 
         client = wait_coordinator(ctx.coordinator_endpoint)
         client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
-        distributed_init(ctx, client)  # multi-host mesh bring-up (no-op if 1 proc)
+        ident = distributed_init(ctx, client)  # multi-host bring-up (None if 1 proc)
     else:  # hermetic demo mode
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
@@ -40,16 +41,17 @@ def main() -> None:
         client = coord.client("worker-0")
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-fit-")
 
-    worker = ElasticWorker(
-        model,
-        client,
-        source,
-        ElasticConfig(
-            checkpoint_dir=ctx.checkpoint_dir,
-            checkpoint_interval=ctx.checkpoint_interval,
-            trainer=TrainerConfig(optimizer="sgd", learning_rate=1e-2),
-        ),
+    cfg = ElasticConfig(
+        checkpoint_dir=ctx.checkpoint_dir,
+        checkpoint_interval=ctx.checkpoint_interval,
+        trainer=TrainerConfig(optimizer="sgd", learning_rate=1e-2),
     )
+    if ident is not None:  # multi-host: lockstep rounds + warm-restart rescale
+        from edl_tpu.runtime import MultiHostWorker
+
+        worker = MultiHostWorker(model, client, source, cfg)
+    else:
+        worker = ElasticWorker(model, client, source, cfg)
     metrics = worker.run()
     print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
 

@@ -29,13 +29,14 @@ def main() -> None:
     model = word2vec.MODEL
     source = SyntheticShardSource(model, batch_size=512, batches_per_shard=10)
 
+    ident = None
     if os.environ.get("EDL_COORDINATOR_ENDPOINT"):
         from edl_tpu.launcher.discovery import wait_coordinator
         from edl_tpu.runtime.distributed import distributed_init
 
         client = wait_coordinator(ctx.coordinator_endpoint)
         client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
-        distributed_init(ctx, client)  # multi-host mesh bring-up (no-op if 1 proc)
+        ident = distributed_init(ctx, client)  # multi-host bring-up (None if 1 proc)
     else:  # hermetic demo mode
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
@@ -45,18 +46,18 @@ def main() -> None:
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-w2v-")
 
     prof = StepProfiler(warmup=1)
-    worker = ElasticWorker(
-        model,
-        client,
-        source,
-        ElasticConfig(
-            checkpoint_dir=ctx.checkpoint_dir,
-            checkpoint_interval=ctx.checkpoint_interval,
-            # ref uses Adam(lr=3e-3) for this model (train_ft.py:102-104)
-            trainer=TrainerConfig(optimizer="adam", learning_rate=3e-3),
-        ),
-        profiler=prof,
+    cfg = ElasticConfig(
+        checkpoint_dir=ctx.checkpoint_dir,
+        checkpoint_interval=ctx.checkpoint_interval,
+        # ref uses Adam(lr=3e-3) for this model (train_ft.py:102-104)
+        trainer=TrainerConfig(optimizer="adam", learning_rate=3e-3),
     )
+    if ident is not None:  # multi-host: lockstep rounds + warm-restart rescale
+        from edl_tpu.runtime import MultiHostWorker
+
+        worker = MultiHostWorker(model, client, source, cfg, profiler=prof)
+    else:
+        worker = ElasticWorker(model, client, source, cfg, profiler=prof)
     metrics = worker.run()
     print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
 

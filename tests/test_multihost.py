@@ -1,0 +1,159 @@
+"""Multi-host SPMD training tests: lockstep rounds across real processes."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from edl_tpu.coordinator import CoordinatorServer
+from edl_tpu.coordinator.server import ensure_built, free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_SRC = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from edl_tpu.launcher.launch import LaunchContext
+from edl_tpu.launcher.discovery import wait_coordinator
+from edl_tpu.models import fit_a_line
+from edl_tpu.runtime import (
+    ElasticConfig, MultiHostWorker, SyntheticShardSource, distributed_init,
+)
+from edl_tpu.runtime.train_loop import TrainerConfig
+
+ctx = LaunchContext.from_env()
+client = wait_coordinator(ctx.coordinator_endpoint)
+client.worker = os.environ["WORKER_NAME"]
+distributed_init(ctx, client, timeout=90.0, jax_port={jax_port})
+worker = MultiHostWorker(
+    fit_a_line.MODEL,
+    client,
+    SyntheticShardSource(fit_a_line.MODEL, batch_size=16,
+                         batches_per_shard=int(os.environ.get("BATCHES_PER_SHARD", "3"))),
+    ElasticConfig(
+        checkpoint_dir=os.environ["CKPT_DIR"],
+        checkpoint_interval=1000,
+        rescale_barrier_timeout=30.0,
+        trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+    ),
+)
+metrics = worker.run()
+print("METRICS " + json.dumps(metrics))
+"""
+
+
+def spawn_worker(name, server, ckpt_dir, jax_port, num_trainers=2):
+    env = dict(os.environ)
+    env["EDL_COORDINATOR_ENDPOINT"] = server.address
+    env["EDL_NUM_TRAINERS"] = str(num_trainers)
+    env["WORKER_NAME"] = name
+    env["CKPT_DIR"] = ckpt_dir
+    src = WORKER_SRC.format(repo=REPO, jax_port=jax_port)
+    return subprocess.Popen(
+        [sys.executable, "-c", src], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+
+
+def test_two_process_lockstep_training(tmp_path):
+    """Two processes drain one queue in lockstep on a single 4-device global
+    mesh; both report identical step counts and the same final loss."""
+    ensure_built()
+    jax_port = free_port()
+    with CoordinatorServer() as server:
+        admin = server.client("admin")
+        admin.add_tasks([f"mh/part-{i:05d}" for i in range(5)])  # odd: tail round
+        procs = [
+            spawn_worker(f"w{i}", server, str(tmp_path / "ck"), jax_port)
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=240) for p in procs]
+        st = server.client("probe").status()
+    metrics = []
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("METRICS ")][0]
+        metrics.append(json.loads(line[len("METRICS "):]))
+    # lockstep: identical step counts; SPMD: identical (global) final loss
+    assert metrics[0]["steps"] == metrics[1]["steps"] > 0
+    assert metrics[0]["final_loss"] == pytest.approx(metrics[1]["final_loss"], abs=1e-6)
+    assert metrics[0]["world"] == 2.0
+    # 5 shards x 3 batches, tail round replicates -> 3 rounds x 3 steps
+    assert metrics[0]["steps"] == 9.0
+    # queue fully drained
+    assert int(st["queued"]) == 0
+
+
+def test_elastic_rescale_one_to_two_processes(tmp_path):
+    """The north-star path end-to-end: a world-1 job is joined by a second
+    trainer; rank 0 detects the epoch bump, checkpoints, exits
+    RESCALE_EXIT_CODE, the launcher relaunches it, and BOTH processes come
+    back as one world-2 jax.distributed job that finishes the queue from the
+    checkpoint."""
+    ensure_built()
+    jax_port = free_port()
+    ckpt = str(tmp_path / "ck")
+    launcher_src = f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+from edl_tpu.launcher.launch import LaunchContext, start_trainer
+ctx = LaunchContext.from_env()
+sys.exit(start_trainer(ctx))
+"""
+    entry_py = tmp_path / "entry.py"
+    entry_py.write_text(WORKER_SRC.format(repo=REPO, jax_port=jax_port))
+
+    with CoordinatorServer(heartbeat_ttl_sec=5.0) as server:
+        admin = server.client("admin")
+        # Enough rounds that the solo phase outlives w1's ~6 s process spawn
+        # (steps are ~ms; rounds serialize on coordinator RPCs).
+        admin.add_tasks([f"mh/part-{i:05d}" for i in range(300)])
+        admin.kv_put("edl/expected_world", "1")
+
+        def spawn_launcher(name):
+            env = dict(os.environ)
+            env["EDL_COORDINATOR_ENDPOINT"] = server.address
+            env["EDL_NUM_TRAINERS"] = "1"
+            env["EDL_ENTRY"] = f"{sys.executable} {entry_py}"
+            env["WORKER_NAME"] = name
+            env["CKPT_DIR"] = ckpt
+            env["BATCHES_PER_SHARD"] = "40"
+            env["EDL_TERMINATION_LOG"] = str(tmp_path / f"term-{name}")
+            return subprocess.Popen(
+                [sys.executable, "-c", launcher_src], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+
+        p0 = spawn_launcher("w0")
+        # scale 1 -> 2 as soon as w0 has real progress (done shards), so the
+        # queue cannot drain before the join on fast or slow boxes alike
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if int(admin.status().get("done", 0)) >= 2:
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("w0 never made progress")
+        admin.kv_put("edl/expected_world", "2")
+        p1 = spawn_launcher("w1")  # registration bumps the epoch -> w0 restarts
+
+        outs = [p.communicate(timeout=300) for p in (p0, p1)]
+        st = server.client("probe").status()
+    for p, (out, err) in zip((p0, p1), outs):
+        assert p.returncode == 0, f"launcher failed:\n{err[-3000:]}\n{out[-2000:]}"
+    # both incarnations printed metrics; the final ones show world=2
+    finals = []
+    for out, _ in outs:
+        lines = [l for l in out.splitlines() if l.startswith("METRICS ")]
+        assert lines, out
+        finals.append(json.loads(lines[-1][len("METRICS "):]))
+    assert finals[0]["world"] == 2.0 and finals[1]["world"] == 2.0
+    assert int(st["queued"]) == 0
