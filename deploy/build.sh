@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build both images (ref: docker/build.sh). Run from the repo root.
+set -e
+TAG="${TAG:-latest}"
+docker build -f deploy/Dockerfile.controller -t "edl-tpu-controller:${TAG}" .
+docker build -f deploy/Dockerfile.trainer -t "edl-tpu:${TAG}" .
+echo "built edl-tpu-controller:${TAG} and edl-tpu:${TAG}"

@@ -54,3 +54,13 @@ def test_job_yamls_pass_admission(yaml_path):
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-1000:]
+
+
+def test_elastic_rebalance_demo():
+    """The reference's published experiment (boss_tutorial utilization
+    trajectory) reproduced on the hermetic control plane."""
+    out = run_example("examples/elastic_demo.py", timeout=180)
+    assert out["ok"] is True
+    assert out["trajectory"][0] == 0.0
+    assert out["trajectory"][-1] > 0.5
+    assert len(out["final_trainers"]) == 3
