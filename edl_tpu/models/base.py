@@ -20,7 +20,7 @@ change.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -40,3 +40,8 @@ class Model:
     #: sequence-sharded inputs (transformer: tokens (B, S) over data x seq)
     #: override this so `Trainer.place_batch` places dims on the right axes.
     batch_spec: Optional[Callable] = None
+    #: batch keys holding the training objective (labels/targets/weights).
+    #: Wire transport never applies lossy encodings to these — a float
+    #: regression target consumed by a float32 loss must cross exactly
+    #: (integer labels keep their exact u8/u24 encodings).
+    label_keys: Tuple[str, ...] = ()

@@ -139,6 +139,7 @@ def make_model(
         loss_fn=lambda params, batch, mesh: _loss_impl(params, batch, mesh, deep, wide),
         param_spec=lambda mesh: _spec_impl(deep, wide),
         synthetic_batch=lambda rng, bs: synthetic_batch(rng, bs, sparse_dim),
+        label_keys=("label",),
     )
 
 

@@ -112,4 +112,5 @@ MODEL = Model(
     loss_fn=loss_fn,
     param_spec=param_spec,
     synthetic_batch=synthetic_batch,
+    label_keys=("label",),
 )

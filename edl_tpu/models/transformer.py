@@ -269,6 +269,7 @@ def make_model(cfg: Optional[TransformerConfig] = None, **overrides) -> Model:
         param_spec=lambda mesh: _param_spec(cfg, mesh),
         synthetic_batch=lambda rng, bs: synthetic_batch(cfg, rng, bs),
         batch_spec=lambda mesh: _batch_specs(cfg, mesh),
+        label_keys=("targets",),
     )
 
 

@@ -43,6 +43,11 @@ class SyntheticShardSource:
         for _ in range(self.batches_per_shard):
             yield self.model.synthetic_batch(rng, self.batch_size)
 
+    def batch_count(self, shard: str) -> int:
+        """Lockstep metadata: lets rank 0 publish a round's exact step count
+        (`edl_tpu.runtime.multihost`) instead of assuming equal shards."""
+        return self.batches_per_shard
+
 
 class LeaseReader:
     """Iterate (shard, batch) pairs by leasing shards from the coordinator.

@@ -13,10 +13,14 @@ lockstep):
 - rank 0 is the decision-maker: each ROUND it checks the membership epoch
   and leases ``world`` shards, then broadcasts the round plan through the
   coordinator KV under an (epoch, round)-scoped key;
-- every rank polls that exact key, trains its assigned shard's batches
-  (shards yield identical batch counts by construction, so steps align),
+- every rank polls that exact key, trains its assigned shard's batches,
   and assembles its local slice into global arrays
-  (`Trainer.place_batch` -> ``jax.make_array_from_process_local_data``);
+  (`Trainer.place_batch` -> ``jax.make_array_from_process_local_data``).
+  When the source exposes ``batch_count(shard)``, rank 0 publishes the
+  round's step count (max over the leased shards) and every rank runs
+  exactly that many steps, cycling a shorter shard's batches to pad —
+  uneven shards therefore cannot desynchronize the collective step count.
+  Sources without the metadata must yield identical batch counts per shard;
 - tail rounds with fewer shards than ranks replicate the remainder across
   ranks (``tasks[r % len]``) so the queue drains without breaking lockstep;
 - **completion lags the checkpoint**: rank 0 holds consumed shards' leases
@@ -93,6 +97,13 @@ class MultiHostWorker:
         #: rank 0 only: shards consumed since the last durable checkpoint —
         #: their leases are held open until a checkpoint covers them.
         self._uncommitted: List[str] = []
+        #: rank 0 only: published round-plan indices not yet GC'd, and the
+        #: last round known to have contained a collective (training step or
+        #: checkpoint). A collective in round R proves every rank consumed
+        #: plans <= R, so GC'ing only up to that high-water mark can never
+        #: delete a plan a straggler still needs (the round-plan GC race).
+        self._plan_rounds: List[int] = []
+        self._collective_hwm: int = -1
 
     # -- plumbing --------------------------------------------------------------
 
@@ -145,11 +156,29 @@ class MultiHostWorker:
         if int(hb["epoch"]) != epoch:
             msg = {"stop": "rescale"}
         else:
-            tasks = []
-            for _ in range(world):
+            tasks: List[str] = []
+            counts: Dict[str, int] = {}
+            has_meta = hasattr(self.source, "batch_count")
+            while len(tasks) < world:
                 task = self.client.acquire_task()
                 if task is None:
                     break
+                if has_meta:
+                    n = int(self.source.batch_count(task))
+                    if n <= 0:
+                        # Empty shard: no data to train, nothing a checkpoint
+                        # must cover — complete it here so it never enters a
+                        # plan (a zero-step round would have no collective and
+                        # would reopen the GC race). Logged loudly because if
+                        # the metadata UNDER-reported, this is the moment the
+                        # shard's data would be silently dropped.
+                        log.warning(
+                            "shard %r has batch_count 0; completing untrained",
+                            task,
+                        )
+                        self.client.complete_task(task)
+                        continue
+                    counts[task] = n
                 tasks.append(task)
             if not tasks:
                 st = self.client.status()
@@ -165,11 +194,24 @@ class MultiHostWorker:
                     msg = {"stop": "wait"}
             else:
                 msg = {"tasks": tasks}
+                if has_meta:
+                    # Lockstep step count for the round: max over the leased
+                    # shards; shorter shards pad by cycling (no data dropped).
+                    msg["steps"] = max(counts.values())
         self.client.kv_put(ROUND_KEY.format(epoch=epoch, round=rnd), json.dumps(msg))
-        # Round plans are read only at their own round index: GC the previous
-        # key so a long job does not grow the coordinator KV unboundedly.
-        if rnd > 0:
-            self.client.kv_del(ROUND_KEY.format(epoch=epoch, round=rnd - 1))
+        self._plan_rounds.append(rnd)
+        # GC old plans, but only up to the last collective round: a collective
+        # in round R is proof every rank already consumed plans <= R. Deleting
+        # anything newer races stragglers on wait-rounds (no barrier there) —
+        # a delayed rank would poll a dead key for rescale_barrier_timeout and
+        # falsely conclude rank 0 died.
+        keep: List[int] = []
+        for r in self._plan_rounds:
+            if r <= self._collective_hwm and r < rnd:
+                self.client.kv_del(ROUND_KEY.format(epoch=epoch, round=r))
+            else:
+                keep.append(r)
+        self._plan_rounds = keep
         return msg
 
     def _poll_round(self, epoch: int, rnd: int, timeout: float) -> dict:
@@ -185,6 +227,46 @@ class MultiHostWorker:
             time.sleep(0.05)
         log.warning("round %d plan never arrived; assuming rescale", rnd)
         return {"stop": "rescale"}
+
+    def _padded_batches(self, shard: str, tasks: List[str], steps: int):
+        """Yield exactly ``steps`` batches for a lockstep round.
+
+        Cycles the rank's own shard to pad when it is shorter than the
+        round's published step count. If the shard yields nothing at all
+        (metadata said it wouldn't — publish-time filtering keeps genuinely
+        empty shards out of plans), falls back to the OTHER shards in the
+        same plan (every rank knows the full task list), mirroring how tail
+        rounds already replicate shards across ranks. Only if every shard in
+        the plan is unreadable does the rank exit for a gang warm-restart.
+        """
+        candidates = [shard] + [t for t in tasks if t != shard]
+        idx = 0
+        produced_this_pass = 0
+        emitted = 0
+        it = iter(self.source.read(candidates[0]))
+        while emitted < steps:
+            try:
+                batch = next(it)
+            except StopIteration:
+                if produced_this_pass == 0:
+                    idx += 1  # shard unreadable: try a peer's shard
+                    if idx >= len(candidates):
+                        log.error(
+                            "no shard in round plan %s yielded batches but "
+                            "plan says %d steps; exiting for restart",
+                            tasks, steps,
+                        )
+                        self._exit_for_restart()
+                    log.warning(
+                        "shard %r yielded no batches; padding from %r",
+                        shard, candidates[idx],
+                    )
+                produced_this_pass = 0
+                it = iter(self.source.read(candidates[idx]))
+                continue
+            produced_this_pass += 1
+            emitted += 1
+            yield batch
 
     # -- main loop -------------------------------------------------------------
 
@@ -236,25 +318,58 @@ class MultiHostWorker:
                 continue
             if msg.get("ckpt"):
                 checkpoint_and_commit()
+                if rank == 0:
+                    self._collective_hwm = rnd  # the save is a barrier
                 continue
 
             tasks = msg["tasks"]
             shard = tasks[rank % len(tasks)]  # tail rounds replicate remainder
-            for batch in self.source.read(shard):
+            ran_steps = 0
+
+            def _train_one(batch) -> None:
+                nonlocal state, ran_steps
                 placed = trainer.place_batch(batch)
                 state, loss = trainer.train_step(state, placed)
+                ran_steps += 1
                 self.steps_done += 1
                 self.losses.append(float(loss))
                 if self.profiler is not None:
                     self.profiler.step(len(next(iter(batch.values()))))
-            if rank == 0:
+
+            steps = msg.get("steps")
+            if steps is None:
+                # No batch_count metadata: shards must align by construction.
+                for batch in self.source.read(shard):
+                    _train_one(batch)
+            else:
+                # Run exactly `steps` collective steps; cycle a shorter
+                # shard's batches so every rank stays in lockstep.
+                for batch in self._padded_batches(shard, tasks, steps):
+                    _train_one(batch)
+            if rank == 0 and ran_steps > 0:
+                # hwm only moves when a collective actually ran this round: a
+                # zero-step round has no barrier, so advancing it would reopen
+                # the GC race on stragglers.
                 self._uncommitted.extend(dict.fromkeys(tasks))  # dedup tail dups
+                self._collective_hwm = rnd  # train steps are global collectives
+            elif rank == 0:
+                # Only reachable on the no-metadata path with all-empty reads:
+                # no collective ran, so complete the shards immediately (they
+                # carry no updates a checkpoint must cover) rather than letting
+                # them requeue forever.
+                log.warning("round %d trained 0 steps; completing %s", rnd, tasks)
+                for t in dict.fromkeys(tasks):
+                    self.client.complete_task(t)
             if int(state.step) - last_ckpt_step >= self.config.checkpoint_interval:
                 # Deterministic across ranks (lockstep step counter), so every
                 # process enters the collective save together.
                 checkpoint_and_commit()
 
-        # drained: final collective checkpoint covers any stragglers
+        # drained: final collective checkpoint covers any stragglers. Plan
+        # keys after the last collective round (including the terminal
+        # "exhausted" plan) are deliberately NOT GC'd — a straggler may still
+        # need to read them to exit; the litter is bounded by one tail's
+        # worth of rounds and dies with the job's coordinator.
         checkpoint_and_commit()
         prof = (
             {f"profile_{k}": v for k, v in self.profiler.summary().items()}

@@ -44,6 +44,9 @@ class TrainerConfig:
     #: compact host->device batch transport (bf16 floats, u8/u24 ints; see
     #: edl_tpu.runtime.wire). Decode happens inside the jitted step.
     wire_transport: bool = False
+    #: extra batch keys (besides the model's label_keys) that must never get
+    #: a lossy wire encoding — e.g. per-sample weights fed to the loss.
+    wire_raw_keys: Tuple[str, ...] = ()
 
 
 def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -124,7 +127,10 @@ class Trainer:
             from edl_tpu.runtime.wire import WireCodec, WireOverflowError
 
             if self._codec is None:
-                self._codec = WireCodec.infer(batch)
+                self._codec = WireCodec.infer(
+                    batch,
+                    no_lossy_keys=(*self.model.label_keys, *self.config.wire_raw_keys),
+                )
                 self._rebuild_wire_jit()
             while True:
                 try:

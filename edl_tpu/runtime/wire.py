@@ -26,7 +26,7 @@ from one example batch is safe.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Iterable, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -60,12 +60,29 @@ class WireCodec:
     # -- inference -------------------------------------------------------------
 
     @classmethod
-    def infer(cls, example: Dict[str, np.ndarray]) -> "WireCodec":
+    def infer(
+        cls,
+        example: Dict[str, np.ndarray],
+        no_lossy_keys: Iterable[str] = (),
+    ) -> "WireCodec":
+        """Infer per-key encodings from one example batch.
+
+        ``no_lossy_keys`` names keys whose values must cross the wire
+        exactly — regression targets / sample weights consumed directly by a
+        float32 loss, where the "precision beyond bf16 never reaches the
+        math" rationale does not hold. Float keys in the set stay ``raw``;
+        integer keys keep their u8/u24 encodings, which are exact (validated
+        per batch) and therefore safe even for labels.
+        """
+        no_lossy = frozenset(no_lossy_keys)
         keys: Dict[str, _KeyCodec] = {}
         for name, arr in example.items():
             a = np.asarray(arr)
             if a.dtype in (np.float32, np.float64):
-                keys[name] = _KeyCodec("bf16", a.dtype)
+                if name in no_lossy:
+                    keys[name] = _KeyCodec("raw", a.dtype)
+                else:
+                    keys[name] = _KeyCodec("bf16", a.dtype)
             elif np.issubdtype(a.dtype, np.integer) and a.size:
                 lo, hi = int(a.min()), int(a.max())
                 if lo >= 0 and hi < 256:

@@ -157,3 +157,126 @@ sys.exit(start_trainer(ctx))
         finals.append(json.loads(lines[-1][len("METRICS "):]))
     assert finals[0]["world"] == 2.0 and finals[1]["world"] == 2.0
     assert int(st["queued"]) == 0
+
+
+def _inproc_client(tasks):
+    """Real in-process coordinator (same contract as the C++ service) — no
+    hand-rolled fake that could drift from the client surface."""
+    from edl_tpu.coordinator import InProcessCoordinator
+
+    coord = InProcessCoordinator()
+    admin = coord.client("admin")
+    admin.add_tasks(tasks)
+    return coord.client("w0")
+
+
+def _make_worker(client, tmp_path, batches_per_shard=3):
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime import ElasticConfig, MultiHostWorker, SyntheticShardSource
+
+    return MultiHostWorker(
+        fit_a_line.MODEL,
+        client,
+        SyntheticShardSource(fit_a_line.MODEL, batch_size=8,
+                             batches_per_shard=batches_per_shard),
+        ElasticConfig(checkpoint_dir=str(tmp_path / "ck")),
+    )
+
+
+def test_round_plan_gc_waits_for_collective(tmp_path):
+    """ADVICE medium fix: plans are GC'd only once a later collective round
+    proves every rank consumed them — never racing stragglers on wait-rounds."""
+    client = _inproc_client(["s0", "s1", "s2", "s3"])
+    ep = int(client.register()["epoch"])
+    w = _make_worker(client, tmp_path)
+
+    k = lambda r: f"edl/mh_round/{ep}/{r}"
+    m0 = w._publish_round(epoch=ep, rnd=0, world=2)  # tasks round (not yet run)
+    assert "tasks" in m0
+    w._publish_round(epoch=ep, rnd=1, world=2)   # no collective seen yet:
+    assert client.kv_get(k(0))                    # round 0 plan must survive
+    assert client.kv_get(k(1))
+
+    w._collective_hwm = 1                        # rounds 0-1 trained (barrier)
+    w._publish_round(epoch=ep, rnd=2, world=2)
+    assert client.kv_get(k(0)) is None           # now provably consumed
+    assert client.kv_get(k(1)) is None
+    assert client.kv_get(k(2))                   # current plan untouched
+
+
+def test_round_plan_includes_lockstep_steps(tmp_path):
+    """Rank 0 publishes the round's exact step count from source metadata
+    (max over leased shards) so uneven shards cannot desync the collective."""
+    client = _inproc_client(["a", "b"])
+    ep = int(client.register()["epoch"])
+    w = _make_worker(client, tmp_path, batches_per_shard=4)
+    msg = w._publish_round(epoch=ep, rnd=0, world=2)
+    assert sorted(msg["tasks"]) == ["a", "b"]
+    assert msg["steps"] == 4
+    assert json.loads(client.kv_get(f"edl/mh_round/{ep}/0"))["steps"] == 4
+
+
+class _UnevenSource:
+    """batch_count metadata with per-shard counts; read honors the counts
+    except for shards listed in `lying` (metadata says n>0, read yields 0)."""
+
+    def __init__(self, counts, lying=()):
+        self.counts = counts
+        self.lying = set(lying)
+
+    def batch_count(self, shard):
+        return self.counts[shard]
+
+    def read(self, shard):
+        if shard in self.lying:
+            return
+        for i in range(self.counts[shard]):
+            yield {"x": shard, "i": i}
+
+
+def test_publish_filters_empty_shards(tmp_path):
+    """Genuinely empty shards are completed at publish time and never enter a
+    plan, so no zero-step round (and no GC-race reopening) can occur."""
+    client = _inproc_client(["e0", "full", "e1", "also"])
+    ep = int(client.register()["epoch"])
+    w = _make_worker(client, tmp_path)
+    w.source = _UnevenSource({"e0": 0, "full": 3, "e1": 0, "also": 2})
+    msg = w._publish_round(epoch=ep, rnd=0, world=4)
+    assert sorted(msg["tasks"]) == ["also", "full"]
+    assert msg["steps"] == 3
+    st = client.status()
+    assert int(st["done"]) == 2  # e0/e1 completed untrained (logged)
+
+
+def test_padded_batches_cycles_short_shard(tmp_path):
+    """A shard shorter than the round's step count pads by cycling its own
+    batches — lockstep preserved, no data dropped."""
+    client = _inproc_client([])
+    w = _make_worker(client, tmp_path)
+    w.source = _UnevenSource({"short": 2, "long": 5})
+    got = list(w._padded_batches("short", ["short", "long"], steps=5))
+    assert len(got) == 5
+    assert [b["i"] for b in got] == [0, 1, 0, 1, 0]  # cycled
+
+
+def test_padded_batches_falls_back_to_peer_shard(tmp_path):
+    """Inconsistent metadata (count>0 but read empty) pads from a peer shard
+    in the same plan instead of crashing the gang."""
+    client = _inproc_client([])
+    w = _make_worker(client, tmp_path)
+    w.source = _UnevenSource({"bad": 3, "good": 3}, lying={"bad"})
+    got = list(w._padded_batches("bad", ["bad", "good"], steps=3))
+    assert len(got) == 3
+    assert all(b["x"] == "good" for b in got)
+
+
+def test_padded_batches_exits_when_all_shards_unreadable(tmp_path):
+    """Every shard unreadable -> exit RESCALE_EXIT_CODE for a gang restart."""
+    from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
+
+    client = _inproc_client([])
+    w = _make_worker(client, tmp_path)
+    w.source = _UnevenSource({"a": 2, "b": 2}, lying={"a", "b"})
+    with pytest.raises(SystemExit) as ei:
+        list(w._padded_batches("a", ["a", "b"], steps=2))
+    assert ei.value.code == RESCALE_EXIT_CODE

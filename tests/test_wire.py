@@ -158,3 +158,33 @@ def test_dedup_gather_unsigned_and_empty_ids():
     ids_e = jnp.zeros((0,), jnp.int32)
     g0 = jax.grad(lambda t: jnp.sum(dedup_gather(t, ids_e)))(table)
     assert float(jnp.abs(g0).max()) == 0.0
+
+
+def test_no_lossy_keys_keep_float_labels_raw():
+    """ADVICE fix: regression targets consumed by a float32 loss must not be
+    bf16-quantized by the wire codec; int labels keep exact encodings."""
+    from edl_tpu.runtime.wire import WireCodec
+
+    example = {
+        "x": np.random.default_rng(0).standard_normal((8, 13)).astype(np.float32),
+        "y": np.random.default_rng(1).standard_normal((8, 1)).astype(np.float32),
+        "label": np.array([0, 1] * 4, dtype=np.int64),
+    }
+    codec = WireCodec.infer(example, no_lossy_keys=("y", "label"))
+    assert codec.keys["x"].encoding == "bf16"
+    assert codec.keys["y"].encoding == "raw"      # float target: exact
+    assert codec.keys["label"].encoding == "u8"   # int label: exact anyway
+    enc = codec.encode(example)
+    np.testing.assert_array_equal(enc["y"], example["y"])
+
+
+def test_trainer_wire_transport_keeps_model_labels_exact():
+    """Trainer-level: fit_a_line declares label_keys=('y',); with wire
+    transport on, the y that reaches the loss is bit-identical."""
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime.wire import WireCodec
+
+    batch = fit_a_line.MODEL.synthetic_batch(np.random.default_rng(0), 16)
+    codec = WireCodec.infer(batch, no_lossy_keys=fit_a_line.MODEL.label_keys)
+    assert codec.keys["y"].encoding == "raw"
+    assert codec.keys["x"].encoding == "bf16"
