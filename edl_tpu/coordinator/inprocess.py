@@ -173,10 +173,20 @@ class InProcessCoordinator:
 
     def barrier(self, worker: str, name: str, count: int, timeout: float = 120.0) -> Dict:
         with self._barrier_cv:
-            b = self._barriers.setdefault(name, {"arrived": set(), "generation": 0})
+            b = self._barriers.setdefault(
+                name, {"arrived": set(), "generation": 0, "want": 0}
+            )
+            if not b["arrived"]:
+                # First arrival of a cycle fixes the count; later arrivals
+                # must agree (mirrors the native server — last-writer-wins
+                # would let mismatched cohorts release each other).
+                b["want"] = count
+            elif count != b.get("want"):
+                return {"ok": False, "error": "barrier count mismatch",
+                        "want": b["want"]}
             gen = b["generation"]
             b["arrived"].add(worker)
-            if len(b["arrived"]) >= count:
+            if len(b["arrived"]) >= b["want"]:
                 b["generation"] += 1
                 b["arrived"] = set()
                 self._barrier_cv.notify_all()

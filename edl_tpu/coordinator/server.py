@@ -55,10 +55,17 @@ class CoordinatorServer:
         port: Optional[int] = None,
         task_lease_sec: float = 16.0,  # ref: -task-timout-dur 16s
         heartbeat_ttl_sec: float = 10.0,
+        host: str = "0.0.0.0",
+        state_file: Optional[str] = None,
     ):
         self.port = port or free_port()
         self.task_lease_sec = task_lease_sec
         self.heartbeat_ttl_sec = heartbeat_ttl_sec
+        self.host = host
+        #: snapshot path for queue/done/kv/epoch durability; a restarted
+        #: server with the same state_file resumes instead of replaying the
+        #: whole dataset (the reference's etcd-sidecar role).
+        self.state_file = state_file
         self._proc: Optional[subprocess.Popen] = None
 
     @property
@@ -67,13 +74,17 @@ class CoordinatorServer:
 
     def start(self, wait: float = 10.0) -> "CoordinatorServer":
         binary = ensure_built()
+        argv = [
+            binary,
+            "--port", str(self.port),
+            "--host", self.host,
+            "--task-lease-sec", str(self.task_lease_sec),
+            "--heartbeat-ttl-sec", str(self.heartbeat_ttl_sec),
+        ]
+        if self.state_file:
+            argv += ["--state-file", self.state_file]
         self._proc = subprocess.Popen(
-            [
-                binary,
-                "--port", str(self.port),
-                "--task-lease-sec", str(self.task_lease_sec),
-                "--heartbeat-ttl-sec", str(self.heartbeat_ttl_sec),
-            ],
+            argv,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
         )
@@ -104,6 +115,14 @@ class CoordinatorServer:
         if self._proc is None:
             return -1
         return self._proc.wait()
+
+    def kill(self) -> None:
+        """Hard-kill (SIGKILL) without graceful shutdown — for crash tests
+        exercising --state-file durability."""
+        if self._proc is not None:
+            self._proc.kill()
+            self._proc.wait()
+            self._proc = None
 
     def stop(self) -> None:
         if self._proc is not None:

@@ -80,6 +80,10 @@ class LaunchContext:
     checkpoint_dir: str = ""
     checkpoint_interval: int = 1000
     termination_log: str = "/dev/termination-log"
+    #: coordinator durability snapshot (queue/done/kv/epoch). Empty -> a
+    #: default under the workspace, so a restarted coordinator pod with any
+    #: persistent volume resumes instead of replaying the dataset.
+    state_file: str = ""
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "LaunchContext":
@@ -102,6 +106,7 @@ class LaunchContext:
             checkpoint_dir=e.get("EDL_CHECKPOINT_DIR", ""),
             checkpoint_interval=int(e.get("EDL_CHECKPOINT_INTERVAL", "1000")),
             termination_log=e.get("EDL_TERMINATION_LOG", "/dev/termination-log"),
+            state_file=e.get("EDL_STATE_FILE", ""),
         )
 
     @property
@@ -152,9 +157,14 @@ def start_coordinator(ctx: LaunchContext, block: bool = True):
     """
     from edl_tpu.coordinator.server import CoordinatorServer
 
-    server = CoordinatorServer(port=ctx.port)
+    state_file = ctx.state_file or os.path.join(
+        ctx.workspace or ".", f"{ctx.job_name}-coordinator-state.jsonl"
+    )
+    server = CoordinatorServer(port=ctx.port, state_file=state_file)
     server.start()
     if ctx.data_shards:
+        # Idempotent across restarts: the server dedups against its restored
+        # todo/leased/done sets, so re-seeding never replays completed shards.
         with server.client("launcher-seed") as c:
             added = c.add_tasks(ctx.data_shards)
         log.info("seeded %d data shards", added)

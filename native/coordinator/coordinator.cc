@@ -21,8 +21,16 @@
 // trainers see the new epoch on their next heartbeat and enter the
 // checkpoint -> rebuild-mesh -> restore rescale path (edl_tpu.runtime.elastic).
 //
+// Durability: --state-file snapshots the task queue (todo+leased merged, a
+// restart requeues live leases for at-least-once replay), the done-set, the
+// KV namespace, and the membership epoch to disk on mutation, restoring at
+// startup — replacing the reference's etcd-sidecar persistence
+// (pkg/jobparser.go:167-184). Without it a restart reseeds the queue with an
+// empty done-set and the whole dataset replays.
+//
 // Build: make (or cmake).
-// Run: edl-coordinator --port 7164 [--task-lease-sec 16] [--heartbeat-ttl-sec 10]
+// Run: edl-coordinator --port 7164 [--host 0.0.0.0] [--task-lease-sec 16]
+//      [--heartbeat-ttl-sec 10] [--state-file /path/state.jsonl]
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -290,8 +298,12 @@ struct Conn {
 
 class Coordinator {
  public:
-  Coordinator(double task_lease_sec, double heartbeat_ttl_sec)
-      : task_lease_sec_(task_lease_sec), heartbeat_ttl_sec_(heartbeat_ttl_sec) {}
+  Coordinator(double task_lease_sec, double heartbeat_ttl_sec,
+              std::string state_file = "")
+      : task_lease_sec_(task_lease_sec), heartbeat_ttl_sec_(heartbeat_ttl_sec),
+        state_file_(std::move(state_file)) {
+    if (!state_file_.empty()) load_state();
+  }
 
   // Returns the response line (possibly empty when the reply is deferred,
   // e.g. a barrier waiter parked until the barrier fills).
@@ -309,7 +321,14 @@ class Coordinator {
 
   void on_disconnect(int fd);
 
+  // Persist durable state (queue/done/kv/epoch) if anything changed since the
+  // last save. Called from the event loop after each batch of requests.
+  void maybe_save_state();
+
  private:
+  void load_state();
+  void save_state();
+  void mark_dirty() { dirty_ = true; }
   std::string op_register(const JsonObject& req);
   std::string op_heartbeat(const JsonObject& req);
   std::string op_leave(const JsonObject& req);
@@ -326,7 +345,8 @@ class Coordinator {
   std::string op_kv_incr(const JsonObject& req);
   std::string op_status();
 
-  void bump_epoch() { epoch_++; }
+  // Epoch is persisted so monotonicity survives restarts.
+  void bump_epoch() { epoch_++; mark_dirty(); }
   // Release all parked sync waiters: ok=true when the epoch rendezvous
   // completed, ok=false (resync) when membership moved underneath them.
   void release_sync(bool ok);
@@ -360,7 +380,93 @@ class Coordinator {
   std::vector<BarrierWaiter> sync_waiters_;
   std::map<std::string, std::string> kv_;
   std::vector<std::pair<int, std::string>> deferred_;
+  std::string state_file_;
+  bool dirty_ = false;
 };
+
+// Durable state is JSON-lines so it reuses the wire parser/writer:
+//   {"k":"meta","epoch":N}
+//   {"k":"todo","tasks":[...]}      (todo + live leases: restart requeues)
+//   {"k":"done","tasks":[...]}
+//   {"k":"kv","key":K,"value":V}    (one line per entry)
+void Coordinator::save_state() {
+  std::string tmp = state_file_ + ".tmp";
+  FILE* f = fopen(tmp.c_str(), "w");
+  if (!f) { perror("state-file open"); return; }
+  std::string out;
+  out += JsonWriter().field("k", "meta").field("epoch", (double)epoch_).done();
+  std::vector<std::string> todo(todo_.begin(), todo_.end());
+  // Live leases are worker-held state; after a restart those workers'
+  // connections (and ranks) are gone, so their tasks go back to the queue —
+  // at-least-once, exactly what lease expiry would have done.
+  for (auto& [task, _] : leased_) todo.push_back(task);
+  out += JsonWriter().field("k", "todo").field("tasks", todo).done();
+  std::vector<std::string> done(done_.begin(), done_.end());
+  out += JsonWriter().field("k", "done").field("tasks", done).done();
+  for (auto& [key, value] : kv_)
+    out += JsonWriter().field("k", "kv").field("key", key).field("value", value).done();
+  bool ok = fwrite(out.data(), 1, out.size(), f) == out.size();
+  ok = fflush(f) == 0 && ok;
+  ok = fsync(fileno(f)) == 0 && ok;
+  fclose(f);
+  if (!ok) { fprintf(stderr, "state-file write failed\n"); return; }
+  if (rename(tmp.c_str(), state_file_.c_str()) != 0) perror("state-file rename");
+}
+
+void Coordinator::load_state() {
+  FILE* f = fopen(state_file_.c_str(), "r");
+  if (!f) return;  // first boot: nothing to restore
+  std::string content;
+  char buf[65536];
+  size_t n;
+  while ((n = fread(buf, 1, sizeof buf, f)) > 0) content.append(buf, n);
+  fclose(f);
+  size_t pos = 0;
+  int restored_tasks = 0, restored_kv = 0;
+  while (pos < content.size()) {
+    size_t nl = content.find('\n', pos);
+    if (nl == std::string::npos) nl = content.size();
+    std::string line = content.substr(pos, nl - pos);
+    pos = nl + 1;
+    if (line.empty()) continue;
+    JsonObject obj;
+    JsonParser parser(line);
+    if (!parser.parse_object(&obj)) continue;
+    std::string kind = get_str(obj, "k");
+    if (kind == "meta") {
+      epoch_ = (long long)get_num(obj, "epoch", 0);
+    } else if (kind == "todo" || kind == "done") {
+      auto it = obj.find("tasks");
+      if (it == obj.end() || it->second.kind != JsonValue::kStrArray) continue;
+      for (auto& t : it->second.arr) {
+        if (kind == "done") {
+          done_.insert(t);
+        } else if (!done_.count(t) && !todo_set_.count(t)) {
+          todo_.push_back(t);
+          todo_set_.insert(t);
+          restored_tasks++;
+        }
+      }
+    } else if (kind == "kv") {
+      kv_[get_str(obj, "key")] = get_str(obj, "value");
+      restored_kv++;
+    }
+  }
+  // A restart IS a membership event (every registration is gone): bump the
+  // epoch so reconnecting workers observe the move and re-rendezvous rather
+  // than trusting pre-restart ranks.
+  epoch_++;
+  dirty_ = true;
+  fprintf(stderr,
+          "edl-coordinator restored state: epoch=%lld todo=%d done=%zu kv=%d\n",
+          epoch_, restored_tasks, done_.size(), restored_kv);
+}
+
+void Coordinator::maybe_save_state() {
+  if (state_file_.empty() || !dirty_) return;
+  save_state();
+  dirty_ = false;
+}
 
 void Coordinator::release_sync(bool ok) {
   if (sync_waiters_.empty() && sync_arrived_.empty()) return;
@@ -487,6 +593,7 @@ std::string Coordinator::op_add_tasks(const JsonObject& req) {
     todo_set_.insert(t);
     added++;
   }
+  if (added) mark_dirty();
   return JsonWriter().field("ok", true).field("added", (double)added)
       .field("queued", (double)todo_.size()).done();
 }
@@ -518,6 +625,7 @@ std::string Coordinator::op_complete_task(const JsonObject& req) {
     return JsonWriter().field("ok", false).field("error", "lease not owned").done();
   leased_.erase(it);
   done_.insert(task);
+  mark_dirty();
   return JsonWriter().field("ok", true).field("done", (double)done_.size())
       .field("queued", (double)todo_.size()).done();
 }
@@ -543,7 +651,16 @@ std::string Coordinator::op_barrier(const JsonObject& req, int fd) {
   if (name.empty() || want <= 0)
     return JsonWriter().field("ok", false).field("error", "name+count required").done();
   Barrier& b = barriers_[name];
-  b.want = want;
+  if (b.arrived.empty()) {
+    // First arrival of a cycle fixes the count; later arrivals must agree.
+    // Last-writer-wins here would let two cohorts sharing a barrier name
+    // with different counts release each other incorrectly.
+    b.want = want;
+  } else if (want != b.want) {
+    return JsonWriter().field("ok", false)
+        .field("error", "barrier count mismatch")
+        .field("want", (double)b.want).done();
+  }
   b.arrived.insert(worker);
   b.waiters.push_back(BarrierWaiter{fd, worker});
   if ((int)b.arrived.size() >= b.want) {
@@ -582,6 +699,7 @@ std::string Coordinator::op_kv_put(const JsonObject& req) {
   std::string key = get_str(req, "key");
   if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
   kv_[key] = get_str(req, "value");
+  mark_dirty();
   return JsonWriter().field("ok", true).done();
 }
 
@@ -595,7 +713,7 @@ std::string Coordinator::op_kv_get(const JsonObject& req) {
 }
 
 std::string Coordinator::op_kv_del(const JsonObject& req) {
-  kv_.erase(get_str(req, "key"));
+  if (kv_.erase(get_str(req, "key"))) mark_dirty();
   return JsonWriter().field("ok", true).done();
 }
 
@@ -615,6 +733,7 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   }
   cur += delta;
   kv_[key] = std::to_string(cur);
+  mark_dirty();
   return JsonWriter().field("ok", true).field("value", (double)cur).done();
 }
 
@@ -682,14 +801,19 @@ void Coordinator::on_disconnect(int fd) {
 
 }  // namespace
 
-int make_listener(int port) {
+int make_listener(const char* host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) { perror("socket"); exit(1); }
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  // Default 0.0.0.0: trainers on OTHER hosts dial the coordinator's service
+  // address, so a loopback-only bind would make multi-host jobs undialable.
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad --host %s (want an IPv4 address)\n", host);
+    exit(1);
+  }
   addr.sin_port = htons(port);
   if (bind(fd, (sockaddr*)&addr, sizeof addr) < 0) { perror("bind"); exit(1); }
   if (listen(fd, 128) < 0) { perror("listen"); exit(1); }
@@ -699,27 +823,33 @@ int make_listener(int port) {
 
 int main(int argc, char** argv) {
   int port = 7164;
+  std::string host = "0.0.0.0";
+  std::string state_file;
   double task_lease = 16.0;   // ref: -task-timout-dur 16s (docker/paddle_k8s:30)
   double hb_ttl = 10.0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
     if (a == "--port") port = atoi(next());
+    else if (a == "--host") host = next();
+    else if (a == "--state-file") state_file = next();
     else if (a == "--task-lease-sec") task_lease = atof(next());
     else if (a == "--heartbeat-ttl-sec") hb_ttl = atof(next());
     else if (a == "--help") {
-      printf("edl-coordinator --port N [--task-lease-sec S] [--heartbeat-ttl-sec S]\n");
+      printf("edl-coordinator --port N [--host A] [--state-file P] "
+             "[--task-lease-sec S] [--heartbeat-ttl-sec S]\n");
       return 0;
     }
   }
   signal(SIGPIPE, SIG_IGN);
 
-  int listener = make_listener(port);
-  fprintf(stderr, "edl-coordinator listening on 127.0.0.1:%d (task-lease %.1fs, hb-ttl %.1fs)\n",
-          port, task_lease, hb_ttl);
+  int listener = make_listener(host.c_str(), port);
+  fprintf(stderr, "edl-coordinator listening on %s:%d (task-lease %.1fs, hb-ttl %.1fs%s%s)\n",
+          host.c_str(), port, task_lease, hb_ttl,
+          state_file.empty() ? "" : ", state-file ", state_file.c_str());
   fflush(stderr);
 
-  Coordinator coord(task_lease, hb_ttl);
+  Coordinator coord(task_lease, hb_ttl, state_file);
   std::map<int, Conn> conns;
 
   while (true) {
@@ -806,6 +936,10 @@ int main(int argc, char** argv) {
       close(fd);
       conns.erase(fd);
     }
+
+    // Durability point: everything this iteration mutated is on disk before
+    // we block in poll again (atomic tmp+rename; no-op when clean).
+    coord.maybe_save_state();
   }
   return 0;
 }
