@@ -11,14 +11,17 @@ kubectl-side workflow its docs walk through (`doc/usage.md:81-118`):
 - ``train``     — run a model from the zoo locally on the live JAX backend
   (the `train_local.py` twin, `example/fit_a_line/train_local.py:41-109`).
 
-Without a Kubernetes API the ``controller``/``run`` commands drive the
-in-memory FakeCluster provider — the hermetic twin the tests use; a real
-cluster provider plugs in behind the same ClusterProvider protocol.
+``controller``/``run`` pick their backend the way `cmd/edl/edl.go:31-36`
+does: ``--in-cluster`` uses the pod serviceaccount, ``--kubeconfig`` (or a
+bare ``--k8s``) a kubeconfig file — both select the Kubernetes-backed
+``K8sCluster`` + ``K8sJobStore``. Without either flag the in-memory
+FakeCluster twin runs, hermetic and TPU-quota-shaped, as in tests.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import logging
 import sys
@@ -34,6 +37,46 @@ def _add_nodes_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--chips-per-host", type=int, default=4, help="TPU chips per host")
     p.add_argument("--cpu-per-host", type=float, default=16.0)
     p.add_argument("--memory-per-host", default="64Gi")
+
+
+def _add_backend_flags(p: argparse.ArgumentParser) -> None:
+    """Backend selection (ref: cmd/edl/edl.go:17-36 kubeconfig flag +
+    in-cluster fallback, made explicit). Any of these flags selects the
+    Kubernetes backend; without them the in-memory FakeCluster twin runs."""
+    p.add_argument("--k8s", action="store_true",
+                   help="use the Kubernetes backend with the default kubeconfig")
+    p.add_argument("--kubeconfig", default=None,
+                   help="kubeconfig path (implies the Kubernetes backend)")
+    p.add_argument("--context", default=None,
+                   help="kubeconfig context (implies the Kubernetes backend)")
+    p.add_argument("--in-cluster", action="store_true",
+                   help="use the pod serviceaccount (implies Kubernetes backend)")
+    p.add_argument("--namespace", default=None,
+                   help="namespace to manage (implies the Kubernetes backend; "
+                        "default: from config)")
+
+
+def _make_backend(args):
+    """(cluster, store) for the selected backend; store None = in-memory.
+
+    May raise ``edl_tpu.k8s.config.ConfigError`` — callers turn that into a
+    CLI error, not a traceback.
+    """
+    wants_k8s = (
+        args.in_cluster or args.kubeconfig or args.k8s
+        or args.context or args.namespace
+    )
+    if wants_k8s:
+        from edl_tpu.k8s import ApiClient, K8sCluster, K8sJobStore, KubeConfig
+
+        if args.in_cluster:
+            cfg = KubeConfig.in_cluster()
+        else:
+            cfg = KubeConfig.from_kubeconfig(args.kubeconfig, args.context)
+        api = ApiClient(cfg)
+        ns = args.namespace
+        return K8sCluster(api, namespace=ns), K8sJobStore(api, namespace=ns)
+    return _make_fake_cluster(args), None
 
 
 def _make_fake_cluster(args):
@@ -74,9 +117,31 @@ def cmd_validate(args) -> int:
     return 0
 
 
-def cmd_run(args) -> int:
+@contextlib.contextmanager
+def _control_plane(args, sink):
+    """Backend + Controller + Collector with symmetric teardown (shared by
+    ``run`` and ``controller``). Raises ConfigError on bad backend flags."""
     from edl_tpu.controller import Controller
     from edl_tpu.tools.collector import Collector
+
+    cluster, store = _make_backend(args)
+    controller = Controller(cluster, store=store,
+                            max_load_desired=args.max_load_desired)
+    controller.start()
+    collector = Collector(controller.store, cluster,
+                          period_seconds=args.collect_period, sink=sink)
+    collector.start()
+    try:
+        yield controller
+    finally:
+        collector.stop()
+        controller.stop()
+        if store is not None:
+            store.stop()
+
+
+def cmd_run(args) -> int:
+    from edl_tpu.k8s.config import ConfigError
 
     try:  # parse + admission-validate before the control plane spins up
         parsed = normalize(_load_job(args.file))
@@ -84,47 +149,43 @@ def cmd_run(args) -> int:
         print(f"INVALID: {e}", file=sys.stderr)
         return 1
 
-    cluster = _make_fake_cluster(args)
-    controller = Controller(cluster, max_load_desired=args.max_load_desired)
-    controller.start()
-    collector = Collector(controller.store, cluster,
-                          period_seconds=args.collect_period, sink=sys.stderr)
-    collector.start()
     try:
-        job = controller.submit(parsed)
-        deadline = time.monotonic() + args.timeout
-        while time.monotonic() < deadline:
-            status = controller.job_status(job.name, job.namespace).status
-            if status.phase.terminal():
-                break
-            time.sleep(0.5)
-        final = controller.job_status(job.name, job.namespace)
-        print(json.dumps(final.to_dict()["status"], indent=2))
-        return 0 if final.status.phase.value == "Succeeded" else 2
-    finally:
-        collector.stop()
-        controller.stop()
+        with _control_plane(args, sink=sys.stderr) as controller:
+            try:
+                job = controller.submit(parsed)
+            except KeyError as e:
+                # K8s mode: the CRD of a previous run may still exist.
+                print(f"ERROR: {e.args[0] if e.args else e} "
+                      "(delete the existing TrainingJob first)", file=sys.stderr)
+                return 1
+            deadline = time.monotonic() + args.timeout
+            while time.monotonic() < deadline:
+                status = controller.job_status(job.name, job.namespace).status
+                if status.phase.terminal():
+                    break
+                time.sleep(0.5)
+            final = controller.job_status(job.name, job.namespace)
+            print(json.dumps(final.to_dict()["status"], indent=2))
+            return 0 if final.status.phase.value == "Succeeded" else 2
+    except ConfigError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_controller(args) -> int:
-    from edl_tpu.controller import Controller
-    from edl_tpu.tools.collector import Collector
+    from edl_tpu.k8s.config import ConfigError
 
-    cluster = _make_fake_cluster(args)
-    controller = Controller(cluster, max_load_desired=args.max_load_desired)
-    controller.start()
-    collector = Collector(controller.store, cluster,
-                          period_seconds=args.collect_period, sink=sys.stdout)
-    collector.start()
-    logging.getLogger("edl_tpu").info("controller running; Ctrl-C to stop")
     try:
-        while True:
-            time.sleep(1.0)
-    except KeyboardInterrupt:
-        return 0
-    finally:
-        collector.stop()
-        controller.stop()
+        with _control_plane(args, sink=sys.stdout):
+            logging.getLogger("edl_tpu").info("controller running; Ctrl-C to stop")
+            try:
+                while True:
+                    time.sleep(1.0)
+            except KeyboardInterrupt:
+                return 0
+    except ConfigError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
 
 
 def cmd_train(args) -> int:
@@ -179,12 +240,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--timeout", type=float, default=600.0)
     p.add_argument("--collect-period", type=float, default=10.0)
     _add_nodes_flags(p)
+    _add_backend_flags(p)
     p.set_defaults(fn=cmd_run)
 
     p = sub.add_parser("controller", help="run the control plane", parents=[common])
     p.add_argument("--max-load-desired", type=float, default=0.97)
     p.add_argument("--collect-period", type=float, default=10.0)
     _add_nodes_flags(p)
+    _add_backend_flags(p)
     p.set_defaults(fn=cmd_controller)
 
     p = sub.add_parser("train", help="train a zoo model locally", parents=[common])

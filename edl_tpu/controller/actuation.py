@@ -1,0 +1,110 @@
+"""Actuation bridge: control-plane scale decisions → the job's coordinator.
+
+The reference's scale actuation is one write — `Spec.Parallelism` on the
+trainer Job (`/root/reference/pkg/autoscaler.go:339-376`) — because its data
+plane discovers world size from Kubernetes itself. Ours is two writes: the
+provider reconciles the pod count, but live workers rendezvous at the world
+size read from the coordinator KV (``edl/expected_world``,
+`edl_tpu/runtime/distributed.py:86-93`). This module is the second write:
+
+1. **publish** the target world under ``edl/expected_world`` *before* the
+   provider actuates, so a worker (re)starting mid-rescale already sees the
+   new target;
+2. **nudge** the membership epoch after actuation (``bump_epoch``), so
+   workers parked in ``sync()`` resync immediately instead of waiting for a
+   pod-churn membership event — this is what turns an autoscaler decision
+   into a live-job warm restart.
+
+Endpoints default to the controller-stamped DNS name
+(`jobparser.coordinator_endpoint`); hermetic tests and local process pools
+override per-job with ``set_endpoint``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Tuple
+
+from edl_tpu.api.types import TrainingJob
+from edl_tpu.controller.jobparser import coordinator_endpoint
+
+log = logging.getLogger("edl_tpu.actuation")
+
+#: KV key the runtime reads its target world size from
+#: (must match edl_tpu/runtime/distributed.py:EXPECTED_WORLD_KEY).
+EXPECTED_WORLD_KEY = "edl/expected_world"
+
+
+class CoordinatorActuator:
+    """Dials per-job coordinators to publish rescale targets."""
+
+    def __init__(self, dial_timeout: float = 3.0):
+        self.dial_timeout = dial_timeout
+        self._lock = threading.Lock()
+        self._endpoints: Dict[str, Tuple[str, int]] = {}
+
+    # -- endpoint registry -----------------------------------------------------
+
+    def track(self, job: TrainingJob) -> None:
+        """Derive the job's coordinator endpoint from its spec (the stable
+        service DNS name the pods themselves dial)."""
+        host, _, port = coordinator_endpoint(job).rpartition(":")
+        with self._lock:
+            # An explicit endpoint (set_endpoint) wins over the derived one:
+            # tests and local pools register the real host:port first.
+            self._endpoints.setdefault(job.name, (host, int(port)))
+
+    def set_endpoint(self, job_name: str, host: str, port: int) -> None:
+        with self._lock:
+            self._endpoints[job_name] = (host, int(port))
+
+    def forget(self, job_name: str) -> None:
+        with self._lock:
+            self._endpoints.pop(job_name, None)
+
+    def _dial(self, job_name: str):
+        with self._lock:
+            endpoint = self._endpoints.get(job_name)
+        if endpoint is None:
+            return None
+        from edl_tpu.coordinator.client import CoordinatorClient
+
+        return CoordinatorClient(
+            host=endpoint[0], port=endpoint[1],
+            worker=f"controller/{job_name}", connect_timeout=self.dial_timeout,
+        )
+
+    # -- the two writes --------------------------------------------------------
+
+    def publish_expected_world(self, job_name: str, world: int) -> bool:
+        """Write the rescale target. Failures (including dial failures — the
+        coordinator may still be materializing, or the DNS name may not
+        resolve outside the cluster) are non-fatal: workers fall back to
+        membership-driven convergence (`EDL_NUM_TRAINERS` + epoch events),
+        and the provider actuation must never be blocked by this write."""
+        try:
+            client = self._dial(job_name)
+            if client is None:
+                return False
+            with client:
+                client.kv_put(EXPECTED_WORLD_KEY, str(int(world)))
+            return True
+        except Exception as e:
+            log.debug("publish expected_world=%d to %s failed: %s",
+                      world, job_name, e)
+            return False
+
+    def nudge(self, job_name: str) -> bool:
+        """Bump the membership epoch so parked workers resync now."""
+        try:
+            client = self._dial(job_name)
+            if client is None:
+                return False
+            with client:
+                epoch = client.bump_epoch()
+            log.info("nudged %s to epoch %d", job_name, epoch)
+            return True
+        except Exception as e:
+            log.debug("nudge of %s failed: %s", job_name, e)
+            return False

@@ -276,6 +276,11 @@ class Autoscaler:
         #: optional actuation listener (job_name, ScaleRecord) — the controller
         #: routes these to the job's updater, the sole status writer.
         self.on_scaled: Optional[Callable[[str, ScaleRecord], None]] = None
+        #: optional CoordinatorActuator: publishes edl/expected_world before
+        #: the provider actuates and nudges the membership epoch after, so
+        #: live workers warm-restart into the new world
+        #: (edl_tpu/controller/actuation.py; ref: autoscaler.go:339-376).
+        self.actuator = None
 
     # -- informer-style callbacks (ref: autoscaler.go:158-171) -----------------
 
@@ -379,7 +384,13 @@ class Autoscaler:
             for attempt in range(self.config.update_retries):
                 try:
                     before = self.cluster.get_trainer_parallelism(name)
+                    if self.actuator is not None:
+                        # Target world goes to the coordinator FIRST: a worker
+                        # (re)starting mid-actuation must already see it.
+                        self.actuator.publish_expected_world(name, parallelism)
                     self.cluster.set_trainer_parallelism(name, parallelism)
+                    if self.actuator is not None:
+                        self.actuator.nudge(name)
                     record = ScaleRecord(
                         timestamp=time.time(),
                         from_replicas=before,

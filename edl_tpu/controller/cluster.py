@@ -148,7 +148,12 @@ class ClusterProvider(Protocol):
     def set_trainer_parallelism(self, job_name: str, parallelism: int) -> None: ...
 
     def create_role(self, job_name: str, role: str, replicas: int,
-                    requests: ResourceList, limits: ResourceList) -> None: ...
+                    requests: ResourceList, limits: ResourceList,
+                    workload: Optional[object] = None) -> None:
+        """Materialize a role. ``workload`` is the full RoleWorkload (image,
+        entrypoint, env) — required by providers that launch real containers
+        (K8sCluster); accounting-only providers may ignore it."""
+        ...
 
     def delete_role(self, job_name: str, role: str) -> None: ...
 
@@ -195,7 +200,8 @@ class FakeCluster:
             self._reconcile(job_name)
 
     def create_role(self, job_name: str, role: str, replicas: int,
-                    requests: ResourceList, limits: ResourceList) -> None:
+                    requests: ResourceList, limits: ResourceList,
+                    workload: Optional[object] = None) -> None:
         with self._lock:
             if role == "trainer":
                 self._parallelism[job_name] = replicas

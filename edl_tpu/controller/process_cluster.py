@@ -1,0 +1,222 @@
+"""ProcessCluster: a ClusterProvider that runs roles as local subprocesses.
+
+The third backend next to ``FakeCluster`` (accounting only) and ``K8sCluster``
+(real cluster): role workloads become real OS processes on this machine, with
+node-granular TPU-chip accounting kept like the fake's. This is the
+single-host "minikube mode" the reference demos its elasticity tutorial on
+(`/root/reference/doc/boss_tutorial.md:163-301`) — the control plane's scale
+decisions spawn and reap actual trainer processes, so autoscaler → coordinator
+→ warm-restart is exercisable end-to-end with no Kubernetes.
+
+Mapping (ref: pkg/cluster.go:91-113,245-291):
+
+- ``create_role``            — spawn ``replicas`` processes from the
+  workload's entrypoint + env (each gets ``EDL_POD_NAME``).
+- ``set_trainer_parallelism``— reconcile the live process count: spawn more,
+  or SIGTERM the newest extras (K8s Job parallelism-reduction order).
+- ``job_pods``               — phase from the process state: Running while
+  alive, Succeeded/Failed from the exit code, Pending when unplaceable.
+- ``delete_role``            — terminate everything carrying the label.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shlex
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from edl_tpu.api.quantity import ResourceList
+from edl_tpu.controller.cluster import NodeInfo, PodInfo, inquire_resource
+
+log = logging.getLogger("edl_tpu.process_cluster")
+
+
+@dataclass
+class _ProcPod:
+    info: PodInfo
+    proc: Optional[subprocess.Popen] = None
+    log_path: str = ""
+    #: spawn spec, kept for Pending pods that place later.
+    entrypoint: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    workspace: str = ""
+
+
+class ProcessCluster:
+    """Local-process ClusterProvider with FakeCluster-style chip accounting."""
+
+    def __init__(self, nodes: List[NodeInfo], log_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self.nodes = list(nodes)
+        self.pods: List[_ProcPod] = []
+        self._parallelism: Dict[str, int] = {}
+        self._templates: Dict[str, Dict[str, object]] = {}  # job -> role -> workload
+        self._counter = 0
+        self.log_dir = log_dir
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+
+    # -- provider interface ----------------------------------------------------
+
+    def inquire(self):
+        with self._lock:
+            self._reap()
+            self._reschedule()
+            return inquire_resource(self.nodes, [p.info for p in self.pods])
+
+    def job_pods(self, job_name: str, role: str = "trainer") -> List[PodInfo]:
+        with self._lock:
+            self._reap()
+            return [
+                p.info for p in self.pods
+                if p.info.job_name == job_name and p.info.role == role
+            ]
+
+    def get_trainer_parallelism(self, job_name: str) -> int:
+        with self._lock:
+            if job_name not in self._parallelism:
+                raise KeyError(f"unknown trainer job {job_name}")
+            return self._parallelism[job_name]
+
+    def set_trainer_parallelism(self, job_name: str, parallelism: int) -> None:
+        with self._lock:
+            if job_name not in self._parallelism:
+                raise KeyError(f"unknown trainer job {job_name}")
+            self._parallelism[job_name] = parallelism
+            self._reconcile(job_name)
+
+    def create_role(self, job_name: str, role: str, replicas: int,
+                    requests: ResourceList, limits: ResourceList,
+                    workload=None) -> None:
+        with self._lock:
+            if role == "trainer":
+                self._parallelism[job_name] = replicas
+            self._templates.setdefault(job_name, {})[role] = (
+                requests, limits, workload
+            )
+            for _ in range(replicas):
+                self._spawn(job_name, role, requests, limits, workload)
+
+    def delete_role(self, job_name: str, role: str) -> None:
+        with self._lock:
+            doomed = [p for p in self.pods
+                      if p.info.job_name == job_name and p.info.role == role]
+            for pod in doomed:
+                self._terminate(pod)
+                self.pods.remove(pod)
+            if role == "trainer":
+                self._parallelism.pop(job_name, None)
+
+    # -- process management ----------------------------------------------------
+
+    def wait_all(self, timeout: float = 300.0) -> None:
+        """Block until every live process exits (test/driver convenience)."""
+        with self._lock:
+            procs = [p.proc for p in self.pods if p.proc is not None]
+        for proc in procs:
+            proc.wait(timeout=timeout)
+        with self._lock:
+            self._reap()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for pod in self.pods:
+                self._terminate(pod)
+            self.pods.clear()
+
+    def _spawn(self, job_name: str, role: str, requests: ResourceList,
+               limits: ResourceList, workload) -> _ProcPod:
+        self._counter += 1
+        name = f"{job_name}-{role}-{self._counter}"
+        pod = _ProcPod(
+            info=PodInfo(name=name, job_name=job_name, role=role,
+                         phase="Pending", requests=requests.copy(),
+                         limits=limits.copy()),
+        )
+        if workload is not None:
+            pod.entrypoint = workload.entrypoint
+            pod.env = dict(workload.env)
+            pod.workspace = getattr(workload, "workspace", "") or ""
+        self.pods.append(pod)
+        self._place_and_start(pod)
+        return pod
+
+    def _place_and_start(self, pod: _ProcPod) -> None:
+        snap = inquire_resource(
+            self.nodes, [p.info for p in self.pods if p is not pod]
+        )
+        node = snap.search_assignable_node(pod.info.requests)
+        if node is None:
+            return  # stays Pending; _reschedule retries
+        pod.info.node = node
+        if not pod.entrypoint:
+            pod.info.phase = "Running"  # accounting-only pod (no workload)
+            return
+        env = dict(os.environ)
+        env.update(pod.env)
+        env["EDL_POD_NAME"] = pod.info.name
+        stdout = subprocess.DEVNULL
+        if self.log_dir:
+            pod.log_path = os.path.join(self.log_dir, f"{pod.info.name}.log")
+            stdout = open(pod.log_path, "w")
+        try:
+            pod.proc = subprocess.Popen(
+                shlex.split(pod.entrypoint), env=env,
+                cwd=pod.workspace or None,
+                stdout=stdout, stderr=subprocess.STDOUT,
+            )
+            pod.info.phase = "Running"
+            log.info("spawned %s: %s (pid %d)",
+                     pod.info.name, pod.entrypoint, pod.proc.pid)
+        except OSError as e:
+            log.error("spawn of %s failed: %s", pod.info.name, e)
+            pod.info.phase = "Failed"
+        finally:
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()
+
+    def _terminate(self, pod: _ProcPod, grace: float = 10.0) -> None:
+        if pod.proc is None or pod.proc.poll() is not None:
+            return
+        pod.proc.terminate()
+        try:
+            pod.proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            pod.proc.kill()
+            pod.proc.wait()
+
+    def _reap(self) -> None:
+        for pod in self.pods:
+            if pod.proc is not None and pod.info.phase == "Running":
+                rc = pod.proc.poll()
+                if rc is not None:
+                    pod.info.phase = "Succeeded" if rc == 0 else "Failed"
+
+    def _reconcile(self, job_name: str) -> None:
+        want = self._parallelism[job_name]
+        live = [p for p in self.pods
+                if p.info.job_name == job_name and p.info.role == "trainer"
+                and p.info.phase in ("Pending", "Running")]
+        if len(live) > want:
+            # Newest-first eviction (K8s Job parallelism reduction). SIGTERM
+            # gives the worker its leave()/checkpoint path; survivors observe
+            # the membership epoch bump and rescale.
+            for pod in live[want:]:
+                self._terminate(pod)
+                self.pods.remove(pod)
+        elif len(live) < want:
+            template = self._templates.get(job_name, {}).get("trainer")
+            if template is None:
+                return
+            requests, limits, workload = template
+            for _ in range(want - len(live)):
+                self._spawn(job_name, "trainer", requests, limits, workload)
+
+    def _reschedule(self) -> None:
+        for pod in self.pods:
+            if pod.info.phase == "Pending":
+                self._place_and_start(pod)

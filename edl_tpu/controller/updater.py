@@ -167,7 +167,8 @@ class JobUpdater:
         if not self.cluster.job_pods(self.job.name, ROLE_COORDINATOR):
             coord = parse_to_coordinator(self.job)
             self.cluster.create_role(
-                self.job.name, ROLE_COORDINATOR, coord.replicas, coord.requests, coord.limits
+                self.job.name, ROLE_COORDINATOR, coord.replicas,
+                coord.requests, coord.limits, workload=coord,
             )
         deadline = time.monotonic() + self.config.create_timeout
         while not self._coordinator_ready():
@@ -183,7 +184,8 @@ class JobUpdater:
         else:
             trainer = parse_to_trainer(self.job)
             self.cluster.create_role(
-                self.job.name, ROLE_TRAINER, trainer.replicas, trainer.requests, trainer.limits
+                self.job.name, ROLE_TRAINER, trainer.replicas,
+                trainer.requests, trainer.limits, workload=trainer,
             )
             self.job.status.parallelism = trainer.replicas
         self._set_phase(JobPhase.RUNNING)

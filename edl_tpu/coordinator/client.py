@@ -111,6 +111,12 @@ class CoordinatorClient:
     def epoch(self) -> int:
         return int(self.call("status")["epoch"])
 
+    def bump_epoch(self) -> int:
+        """Force an epoch bump + sync release (the control plane's rescale
+        nudge): live workers parked in sync() resync immediately instead of
+        waiting for a membership event. Returns the new epoch."""
+        return int(self.call("bump_epoch")["epoch"])
+
     # -- task queue ------------------------------------------------------------
 
     def add_tasks(self, tasks: List[str]) -> int:

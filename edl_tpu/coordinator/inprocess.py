@@ -232,6 +232,14 @@ class InProcessCoordinator:
                         "epoch": self._epoch, "world": len(self._members)}
             return {"ok": True, "epoch": self._epoch, "world": len(self._members)}
 
+    def bump_epoch(self) -> Dict:
+        """Control-plane membership nudge (matches the C++ op_bump_epoch):
+        parked sync waiters resync so workers observe a rescale immediately."""
+        with self._barrier_cv:
+            self._epoch += 1
+            self._release_sync()
+            return {"ok": True, "epoch": self._epoch}
+
     def kv_put(self, key: str, value: str) -> None:
         with self._lock:
             self._kv[key] = value
@@ -324,6 +332,10 @@ class InProcessClient:
 
     def sync(self, epoch, timeout=60.0):
         return self._c.sync(self.worker, epoch, timeout)
+
+    def bump_epoch(self):
+        # int, matching CoordinatorClient.bump_epoch's unwrapped return.
+        return int(self._c.bump_epoch()["epoch"])
 
     def kv_put(self, key, value):
         return self._c.kv_put(key, value)

@@ -8,7 +8,13 @@ orbax async checkpoints, and checkpoint-restore mesh rescale.
 
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
-from edl_tpu.runtime.data import LeaseReader, SyntheticShardSource, shard_names
+from edl_tpu.runtime.data import (
+    FileShardSource,
+    LeaseReader,
+    SyntheticShardSource,
+    shard_names,
+    write_shard,
+)
 from edl_tpu.runtime.distributed import DistributedIdentity, distributed_init
 from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
 from edl_tpu.runtime.multihost import MultiHostWorker
@@ -19,6 +25,7 @@ __all__ = [
     "DistributedIdentity",
     "ElasticConfig",
     "ElasticWorker",
+    "FileShardSource",
     "LeaseReader",
     "MultiHostWorker",
     "RescaleEvent",
@@ -31,4 +38,5 @@ __all__ = [
     "distributed_init",
     "live_state_specs",
     "shard_names",
+    "write_shard",
 ]

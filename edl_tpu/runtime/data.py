@@ -3,17 +3,32 @@
 The reference's fault-tolerant trainers pull chunked tasks from the master's
 etcd-backed queue (`cloud_reader(etcd_endpoint)`,
 `example/fit_a_line/train_ft.py:111-114`); non-FT trainers statically split
-files by rank (`example/fit_a_line/fluid/common.py:24-40`). Here a shard is a
-coordinator lease: trainers acquire, produce that shard's batches, complete.
-At-least-once: a shard leased by a departed/stalled trainer requeues, and
-replays are deterministic (batches derive from the shard id).
+files by rank (`example/fit_a_line/fluid/common.py:24-40`), and the CTR
+example downloads per-trainer file shards before training
+(`example/ctr/ctr/train.py:221-227`). Here a shard is a coordinator lease:
+trainers acquire, produce that shard's batches, complete. At-least-once: a
+shard leased by a departed/stalled trainer requeues, and replays are
+deterministic (synthetic batches derive from the shard id; file batches from
+the file's bytes).
+
+Two sources:
+
+- ``SyntheticShardSource`` — hermetic: batches generated from the shard id.
+- ``FileShardSource``      — production: shard id → ``.npz`` file under a
+  root directory, with a sidecar row count so rank 0 can publish exact
+  lockstep step counts for genuinely uneven shards
+  (`edl_tpu.runtime.multihost`). TPU-first detail: every batch has the SAME
+  static shape — a partial tail is padded by wrapping rows — so one jit
+  compilation serves the whole dataset (no shape-polymorphic recompiles).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
+import os
 from dataclasses import dataclass
-from typing import Callable, Dict, Iterator, List, Optional
+from typing import Callable, Dict, Iterator, List, Mapping, Optional
 
 import numpy as np
 
@@ -25,8 +40,13 @@ def shard_names(prefix: str, count: int) -> List[str]:
     return [f"{prefix}/part-{i:05d}" for i in range(count)]
 
 
-def _shard_seed(shard: str) -> int:
+def shard_seed(shard: str) -> int:
+    """Stable 64-bit seed for a shard id (sha256-based — NOT ``hash()``,
+    which is salted per process and would break cross-run determinism)."""
     return int.from_bytes(hashlib.sha256(shard.encode()).digest()[:8], "little")
+
+
+_shard_seed = shard_seed  # internal alias, kept for existing callers
 
 
 @dataclass
@@ -47,6 +67,90 @@ class SyntheticShardSource:
         """Lockstep metadata: lets rank 0 publish a round's exact step count
         (`edl_tpu.runtime.multihost`) instead of assuming equal shards."""
         return self.batches_per_shard
+
+
+def write_shard(root: str, shard: str, arrays: Mapping[str, np.ndarray]) -> str:
+    """Write one shard: stacked arrays (leading dim = rows) to
+    ``<root>/<shard>.npz`` plus a ``.meta.json`` sidecar with the row count —
+    the metadata ``FileShardSource.batch_count`` serves without decompressing
+    the arrays. Returns the data file path."""
+    rows = {a.shape[0] for a in arrays.values()}
+    if len(rows) != 1:
+        raise ValueError(f"arrays disagree on row count: { {k: v.shape for k, v in arrays.items()} }")
+    path = os.path.join(root, f"{shard}.npz")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, path)  # atomic: a concurrent reader sees old or new, never half
+    meta = {"rows": int(next(iter(rows)))}
+    tmp_meta = f"{path}.meta.json.tmp-{os.getpid()}"
+    with open(tmp_meta, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp_meta, f"{path}.meta.json")
+    return path
+
+
+@dataclass
+class FileShardSource:
+    """Shard id → on-disk ``.npz`` file; deterministic replay, static shapes.
+
+    The production source the reference gets from per-trainer file downloads
+    (`example/ctr/ctr/train.py:221-227`) and file-split readers
+    (`example/fit_a_line/fluid/common.py:24-40`) — but lease-driven instead of
+    rank-keyed, so elastic membership changes redistribute files instead of
+    orphaning them.
+
+    Replay determinism: batches are consecutive row slices of the file (tail
+    padded by wrapping to keep the batch shape static for XLA); re-reading a
+    requeued shard yields bit-identical batches.
+    """
+
+    root: str
+    batch_size: int
+
+    def path(self, shard: str) -> str:
+        return os.path.join(self.root, f"{shard}.npz")
+
+    def read(self, shard: str) -> Iterator[Dict[str, np.ndarray]]:
+        with np.load(self.path(shard)) as data:
+            arrays = {k: data[k] for k in data.files}
+        rows = next(iter(arrays.values())).shape[0] if arrays else 0
+        for start in range(0, rows, self.batch_size):
+            idx = np.arange(start, start + self.batch_size)
+            # wrap the tail: static batch shape, no rows dropped
+            yield {k: np.take(a, idx, axis=0, mode="wrap")
+                   for k, a in arrays.items()}
+
+    def rows(self, shard: str) -> int:
+        meta_path = f"{self.path(shard)}.meta.json"
+        try:
+            with open(meta_path) as f:
+                return int(json.load(f)["rows"])
+        except (OSError, ValueError, KeyError):
+            # Sidecar missing (foreign writer): fall back to reading the file.
+            try:
+                with np.load(self.path(shard)) as data:
+                    if not data.files:
+                        return 0
+                    return int(data[data.files[0]].shape[0])
+            except OSError:
+                return 0
+
+    def batch_count(self, shard: str) -> int:
+        """Real lockstep metadata for uneven shards: ceil(rows/batch_size)."""
+        rows = self.rows(shard)
+        return -(-rows // self.batch_size) if rows > 0 else 0
+
+    def list_shards(self) -> List[str]:
+        """All shard ids present under root (relative paths, no extension)."""
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                if name.endswith(".npz"):
+                    rel = os.path.relpath(os.path.join(dirpath, name), self.root)
+                    out.append(rel[: -len(".npz")])
+        return sorted(out)
 
 
 class LeaseReader:

@@ -7,6 +7,14 @@ cloud vs local mode by env. The PS transpile + ParallelExecutor machinery
 (`train.py:141-151,211-231`) has no equivalent: one jitted SPMD step covers
 both, and elasticity is checkpoint-restore rescale instead of pserver-held
 state.
+
+Data modes (ref per-trainer shard download, `train.py:221-227`):
+
+- default: hermetic ``SyntheticShardSource`` (batches derived from shard ids);
+- ``--prepare N --data-dir D``: materialize N on-disk ``.npz`` click-log
+  shards (deliberately uneven row counts unless ``--even``) and exit;
+- ``--data-dir D``: train from those files via ``FileShardSource`` — real
+  lockstep metadata, real uneven shards, deterministic replay.
 """
 
 import argparse
@@ -14,10 +22,18 @@ import json
 import os
 import tempfile
 
+import numpy as np
+
 from edl_tpu.launcher.launch import LaunchContext
 from edl_tpu.models import ctr
-from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
-from edl_tpu.runtime.data import shard_names
+from edl_tpu.runtime import (
+    ElasticConfig,
+    ElasticWorker,
+    FileShardSource,
+    SyntheticShardSource,
+    write_shard,
+)
+from edl_tpu.runtime.data import shard_names, shard_seed
 from edl_tpu.runtime.train_loop import TrainerConfig
 
 
@@ -30,16 +46,53 @@ def parse_args():
     parser.add_argument("--batches-per-shard", type=int, default=50)
     parser.add_argument("--shard-axis", default="data",
                         help="mesh axis the sparse tables shard over")
+    parser.add_argument("--data-dir", default=os.environ.get("EDL_DATA_DIR", ""),
+                        help="train from .npz shards under this directory")
+    parser.add_argument("--prepare", type=int, default=0, metavar="N",
+                        help="write N click-log shards to --data-dir and exit")
+    parser.add_argument("--rows-per-shard", type=int, default=0,
+                        help="base rows per prepared shard "
+                             "(default: 4 x batch size)")
+    parser.add_argument("--even", action="store_true",
+                        help="prepare equal-size shards (default: uneven)")
     return parser.parse_args()
+
+
+def prepare(args) -> None:
+    """Materialize deterministic click-log shards on disk.
+
+    Shard i's rows derive from a seed of its id, so any trainer preparing
+    the same dataset writes bit-identical files (the reference's downloaded
+    shards are likewise immutable inputs). Row counts are uneven by default
+    — the case the lockstep padding machinery exists for.
+    """
+    base = args.rows_per_shard or 4 * args.batch_size
+    written = {}
+    for shard in shard_names("criteo", args.prepare):
+        rng = np.random.default_rng(shard_seed(shard))
+        rows = base if args.even else base + int(rng.integers(0, base))
+        batch = ctr.synthetic_batch(rng, rows, args.sparse_feature_dim)
+        write_shard(args.data_dir, shard, batch)
+        written[shard] = rows
+    print(json.dumps({"prepared": len(written), "rows": written,
+                      "data_dir": args.data_dir}))
 
 
 def main() -> None:
     args = parse_args()
+    if args.prepare:
+        if not args.data_dir:
+            raise SystemExit("--prepare requires --data-dir")
+        prepare(args)
+        return
     ctx = LaunchContext.from_env()
     model = ctr.make_model(shard_axis=args.shard_axis,
                            sparse_dim=args.sparse_feature_dim)
-    source = SyntheticShardSource(model, batch_size=args.batch_size,
-                                  batches_per_shard=args.batches_per_shard)
+    if args.data_dir:
+        source = FileShardSource(root=args.data_dir, batch_size=args.batch_size)
+    else:
+        source = SyntheticShardSource(model, batch_size=args.batch_size,
+                                      batches_per_shard=args.batches_per_shard)
 
     ident = None
     if os.environ.get("EDL_COORDINATOR_ENDPOINT"):  # cloud mode (ref :192-203)
@@ -53,7 +106,10 @@ def main() -> None:
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
         coord = InProcessCoordinator()
-        coord.add_tasks(ctx.data_shards or shard_names("criteo", 4))
+        if args.data_dir:
+            coord.add_tasks(ctx.data_shards or source.list_shards())
+        else:
+            coord.add_tasks(ctx.data_shards or shard_names("criteo", 4))
         client = coord.client("worker-0")
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-ctr-")
 

@@ -343,6 +343,7 @@ class Coordinator {
   std::string op_kv_get(const JsonObject& req);
   std::string op_kv_del(const JsonObject& req);
   std::string op_kv_incr(const JsonObject& req);
+  std::string op_bump_epoch();
   std::string op_status();
 
   // Epoch is persisted so monotonicity survives restarts.
@@ -737,6 +738,15 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   return JsonWriter().field("ok", true).field("value", (double)cur).done();
 }
 
+std::string Coordinator::op_bump_epoch() {
+  // Control-plane membership nudge (autoscaler actuation): force every
+  // parked sync waiter to resync so live workers observe a rescale without
+  // waiting for a membership event (new-pod register / lease expiry).
+  bump_epoch();
+  release_sync(false);
+  return JsonWriter().field("ok", true).field("epoch", (double)epoch_).done();
+}
+
 std::string Coordinator::op_status() {
   return JsonWriter()
       .field("ok", true)
@@ -764,6 +774,7 @@ std::string Coordinator::handle(const JsonObject& req, int fd) {
   if (op == "kv_get") return op_kv_get(req);
   if (op == "kv_del") return op_kv_del(req);
   if (op == "kv_incr") return op_kv_incr(req);
+  if (op == "bump_epoch") return op_bump_epoch();
   if (op == "status") return op_status();
   if (op == "ping") return JsonWriter().field("ok", true).field("pong", true).done();
   return JsonWriter().field("ok", false).field("error", "unknown op: " + op).done();

@@ -1,0 +1,455 @@
+"""An in-process fake kube-apiserver for hermetic K8s-backend tests.
+
+Plays the role the generated fake clientset plays for the reference
+(`/root/reference/pkg/client/clientset/versioned/fake/clientset_generated.go:
+32-69`): an in-memory object tracker behind the real client code paths —
+except ours sits behind actual HTTP, so `edl_tpu.k8s`'s REST client, watch
+streaming, auth headers, and error mapping are all exercised for real.
+
+Implements the subset the K8s backend touches:
+
+- nodes (seeded by tests), pods (list by labelSelector, deletecollection)
+- apps/v1 Deployments, batch/v1 Jobs (parallelism patch reconciles pods),
+  v1 Services
+- the ``trainingjobs.edl.tpu`` CRD: CRUD + ``/status`` subresource + chunked
+  watch streams with resourceVersion resume
+
+Pod lifecycle is simulated K8s-scheduler-style: pods materialize from
+workload templates, get first-fit node assignment against allocatable
+capacity, and run with phase Running (or stay Pending when nothing fits).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+def _match_selector(labels: Dict[str, str], selector: str) -> bool:
+    for clause in filter(None, selector.split(",")):
+        key, _, value = clause.partition("=")
+        if labels.get(key) != value:
+            return False
+    return True
+
+
+def _quantity_to_float(value) -> float:
+    s = str(value)
+    suffixes = {
+        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+    }
+    for suffix in sorted(suffixes, key=len, reverse=True):
+        if s.endswith(suffix):
+            return float(s[: -len(suffix)]) * suffixes[suffix]
+    return float(s)
+
+
+class FakeApiServer:
+    """State + HTTP server. Start with ``serve()``, stop with ``close()``."""
+
+    def __init__(self, token: Optional[str] = None):
+        self.lock = threading.RLock()
+        self.rv_counter = 0
+        self.token = token  # when set, requests must carry it
+        self.auth_seen: List[str] = []
+        # (namespace, name) -> object dicts
+        self.nodes: Dict[str, dict] = {}
+        self.pods: Dict[Tuple[str, str], dict] = {}
+        self.deployments: Dict[Tuple[str, str], dict] = {}
+        self.jobs: Dict[Tuple[str, str], dict] = {}
+        self.services: Dict[Tuple[str, str], dict] = {}
+        self.trainingjobs: Dict[Tuple[str, str], dict] = {}
+        self.tj_events: List[dict] = []  # {"type","object","rv"}
+        self.event_cond = threading.Condition(self.lock)
+        self.pod_counter = 0
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._closing = False
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def serve(self) -> str:
+        handler = _make_handler(self)
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self._server.daemon_threads = True
+        threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        with self.event_cond:
+            self._closing = True
+            self.event_cond.notify_all()
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+
+    # -- state helpers ---------------------------------------------------------
+
+    def next_rv(self) -> str:
+        self.rv_counter += 1
+        return str(self.rv_counter)
+
+    def add_node(self, name: str, allocatable: Dict[str, str]) -> None:
+        with self.lock:
+            self.nodes[name] = {
+                "metadata": {"name": name},
+                "status": {"allocatable": dict(allocatable)},
+            }
+
+    def _stamp(self, obj: dict, namespace: str) -> dict:
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("namespace", namespace)
+        meta["resourceVersion"] = self.next_rv()
+        return obj
+
+    def record_tj_event(self, kind: str, obj: dict) -> None:
+        with self.event_cond:
+            self.tj_events.append(
+                {"type": kind, "object": json.loads(json.dumps(obj)),
+                 "rv": int(obj["metadata"]["resourceVersion"])}
+            )
+            self.event_cond.notify_all()
+
+    # -- pod simulation --------------------------------------------------------
+
+    def _node_free(self, node_name: str) -> Dict[str, float]:
+        free = {
+            k: _quantity_to_float(v)
+            for k, v in self.nodes[node_name]["status"]["allocatable"].items()
+        }
+        for pod in self.pods.values():
+            if pod["spec"].get("nodeName") == node_name and (
+                pod["status"]["phase"] not in ("Succeeded", "Failed")
+            ):
+                for c in pod["spec"].get("containers", []):
+                    for k, v in (c.get("resources", {}).get("requests") or {}).items():
+                        free[k] = free.get(k, 0.0) - _quantity_to_float(v)
+        return free
+
+    def _fit_node(self, requests: Dict[str, str]) -> Optional[str]:
+        need = {k: _quantity_to_float(v) for k, v in (requests or {}).items()}
+        for name in self.nodes:
+            free = self._node_free(name)
+            if all(free.get(k, 0.0) >= v for k, v in need.items()):
+                return name
+        return None
+
+    def spawn_pod(self, namespace: str, owner_name: str, template: dict) -> dict:
+        self.pod_counter += 1
+        template = json.loads(json.dumps(template))
+        labels = template.get("metadata", {}).get("labels", {})
+        spec = template.get("spec", {})
+        requests = {}
+        for c in spec.get("containers", []):
+            requests.update(c.get("resources", {}).get("requests") or {})
+        pod = {
+            "metadata": {
+                "name": f"{owner_name}-{self.pod_counter}",
+                "namespace": namespace,
+                "labels": labels,
+            },
+            "spec": spec,
+            "status": {"phase": "Pending"},
+        }
+        node = self._fit_node(requests)
+        if node is not None:
+            pod["spec"]["nodeName"] = node
+            pod["status"]["phase"] = "Running"
+        self._stamp(pod, namespace)
+        self.pods[(namespace, pod["metadata"]["name"])] = pod
+        return pod
+
+    def reconcile_job_pods(self, namespace: str, job: dict) -> None:
+        """Match live pods of a batch Job to spec.parallelism."""
+        name = job["metadata"]["name"]
+        selector = job["spec"]["template"]["metadata"].get("labels", {})
+        want = int(job["spec"].get("parallelism", 0))
+        live = [
+            key for key, pod in self.pods.items()
+            if key[0] == namespace
+            and _match_selector(
+                pod["metadata"].get("labels", {}),
+                ",".join(f"{k}={v}" for k, v in selector.items()),
+            )
+            and pod["status"]["phase"] in ("Pending", "Running")
+        ]
+        if len(live) > want:
+            for key in live[want:]:
+                del self.pods[key]
+        else:
+            for _ in range(want - len(live)):
+                self.spawn_pod(namespace, name, job["spec"]["template"])
+
+    def set_pod_phase(self, namespace: str, name: str, phase: str) -> None:
+        with self.lock:
+            self.pods[(namespace, name)]["status"]["phase"] = phase
+
+
+def _make_handler(srv: FakeApiServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet
+            pass
+
+        # -- plumbing ----------------------------------------------------------
+
+        def _send(self, code: int, obj: dict) -> None:
+            payload = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _error(self, code: int, message: str) -> None:
+            self._send(code, {"kind": "Status", "code": code, "message": message})
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length)) if length else {}
+
+        def _route(self) -> Tuple[List[str], Dict[str, str]]:
+            parsed = urllib.parse.urlsplit(self.path)
+            params = dict(urllib.parse.parse_qsl(parsed.query))
+            return [p for p in parsed.path.split("/") if p], params
+
+        def _authorized(self) -> bool:
+            auth = self.headers.get("Authorization", "")
+            srv.auth_seen.append(auth)
+            if srv.token and auth != f"Bearer {srv.token}":
+                self._error(401, "unauthorized")
+                return False
+            return True
+
+        # -- dispatch ----------------------------------------------------------
+
+        def do_GET(self):
+            if not self._authorized():
+                return
+            parts, params = self._route()
+            with srv.lock:
+                # /api/v1/nodes
+                if parts == ["api", "v1", "nodes"]:
+                    return self._send(200, {"items": list(srv.nodes.values())})
+                # /api/v1/pods (all namespaces)
+                if parts == ["api", "v1", "pods"]:
+                    return self._list(srv.pods, None, params)
+                # /api/v1/namespaces/{ns}/pods
+                if len(parts) == 5 and parts[:3] == ["api", "v1", "namespaces"] \
+                        and parts[4] == "pods":
+                    return self._list(srv.pods, parts[3], params)
+                # batch jobs get
+                if len(parts) == 7 and parts[:2] == ["apis", "batch"] \
+                        and parts[5] == "jobs":
+                    job = srv.jobs.get((parts[4], parts[6]))
+                    if job is None:
+                        return self._error(404, "job not found")
+                    return self._send(200, job)
+                # trainingjobs
+                if parts[:3] == ["apis", "edl.tpu", "v1"]:
+                    return self._get_tj(parts[3:], params)
+            self._error(404, f"no route {self.path}")
+
+        def _list(self, table, namespace, params):
+            selector = params.get("labelSelector", "")
+            items = [
+                obj for (ns, _), obj in table.items()
+                if (namespace is None or ns == namespace)
+                and _match_selector(obj["metadata"].get("labels", {}), selector)
+            ]
+            self._send(200, {"items": items,
+                             "metadata": {"resourceVersion": str(srv.rv_counter)}})
+
+        def _get_tj(self, rest: List[str], params: Dict[str, str]):
+            # rest: [trainingjobs] | [namespaces, ns, trainingjobs, name?]
+            if rest and rest[0] == "trainingjobs":
+                if params.get("watch") == "true":
+                    return self._watch_tj(params)
+                return self._list(srv.trainingjobs, None, params)
+            if len(rest) >= 3 and rest[0] == "namespaces" and rest[2] == "trainingjobs":
+                ns = rest[1]
+                if len(rest) == 3:
+                    if params.get("watch") == "true":
+                        return self._watch_tj(params, namespace=ns)
+                    return self._list(srv.trainingjobs, ns, params)
+                obj = srv.trainingjobs.get((ns, rest[3]))
+                if obj is None:
+                    return self._error(404, "trainingjob not found")
+                return self._send(200, obj)
+            self._error(404, "no trainingjob route")
+
+        def _watch_tj(self, params: Dict[str, str], namespace: Optional[str] = None):
+            try:
+                since = int(params.get("resourceVersion") or srv.rv_counter)
+            except ValueError:
+                since = srv.rv_counter
+            timeout = float(params.get("timeoutSeconds", 30))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def emit(event: dict) -> bool:
+                data = json.dumps(
+                    {"type": event["type"], "object": event["object"]}
+                ).encode() + b"\n"
+                try:
+                    self.wfile.write(hex(len(data))[2:].encode() + b"\r\n"
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+                    return True
+                except OSError:
+                    return False
+
+            import time
+            deadline = time.monotonic() + timeout
+            cursor = since
+            while True:
+                with srv.event_cond:
+                    pending = [
+                        e for e in srv.tj_events
+                        if e["rv"] > cursor and (
+                            namespace is None
+                            or e["object"]["metadata"]["namespace"] == namespace
+                        )
+                    ]
+                    if not pending:
+                        if srv._closing or time.monotonic() >= deadline:
+                            break
+                        srv.event_cond.wait(
+                            timeout=min(0.2, max(0.0, deadline - time.monotonic()))
+                        )
+                        continue
+                for event in pending:
+                    cursor = event["rv"]
+                    if not emit(event):
+                        return
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except OSError:
+                pass
+
+        def do_POST(self):
+            if not self._authorized():
+                return
+            parts, _ = self._route()
+            body = self._body()
+            with srv.lock:
+                if len(parts) >= 5 and parts[-1] == "deployments":
+                    return self._create(srv.deployments, parts[-2], body,
+                                        kind="deployment")
+                if len(parts) >= 5 and parts[-1] == "jobs":
+                    return self._create(srv.jobs, parts[-2], body, kind="job")
+                if len(parts) >= 5 and parts[-1] == "services":
+                    return self._create(srv.services, parts[-2], body,
+                                        kind="service")
+                if len(parts) >= 5 and parts[-1] == "trainingjobs":
+                    return self._create(srv.trainingjobs, parts[-2], body,
+                                        kind="trainingjob")
+            self._error(404, f"no POST route {self.path}")
+
+        def _create(self, table, namespace, body, kind):
+            name = body.get("metadata", {}).get("name")
+            if not name:
+                return self._error(400, "metadata.name required")
+            if (namespace, name) in table:
+                return self._error(409, f"{kind} {name} already exists")
+            srv._stamp(body, namespace)
+            table[(namespace, name)] = body
+            if kind == "deployment":
+                for _ in range(int(body["spec"].get("replicas", 1))):
+                    srv.spawn_pod(namespace, name, body["spec"]["template"])
+            elif kind == "job":
+                srv.reconcile_job_pods(namespace, body)
+            elif kind == "trainingjob":
+                body.setdefault("status", {})
+                srv.record_tj_event("ADDED", body)
+            self._send(201, body)
+
+        def do_PATCH(self):
+            if not self._authorized():
+                return
+            parts, _ = self._route()
+            body = self._body()
+            with srv.lock:
+                if len(parts) == 7 and parts[1] == "batch" and parts[5] == "jobs":
+                    job = srv.jobs.get((parts[4], parts[6]))
+                    if job is None:
+                        return self._error(404, "job not found")
+                    _merge(job, body)
+                    srv._stamp(job, parts[4])
+                    srv.reconcile_job_pods(parts[4], job)
+                    return self._send(200, job)
+                if parts[:3] == ["apis", "edl.tpu", "v1"] and len(parts) >= 7:
+                    ns, name = parts[4], parts[6]
+                    is_status = len(parts) == 8 and parts[7] == "status"
+                    obj = srv.trainingjobs.get((ns, name))
+                    if obj is None:
+                        return self._error(404, "trainingjob not found")
+                    if is_status:
+                        # status subresource: only .status is applied
+                        obj["status"] = body.get("status", {})
+                    else:
+                        body.pop("status", None)
+                        _merge(obj, body)
+                    srv._stamp(obj, ns)
+                    srv.record_tj_event("MODIFIED", obj)
+                    return self._send(200, obj)
+            self._error(404, f"no PATCH route {self.path}")
+
+        def do_DELETE(self):
+            if not self._authorized():
+                return
+            parts, params = self._route()
+            with srv.lock:
+                # deletecollection of pods by selector
+                if len(parts) == 5 and parts[4] == "pods":
+                    selector = params.get("labelSelector", "")
+                    doomed = [
+                        key for key, pod in srv.pods.items()
+                        if key[0] == parts[3] and _match_selector(
+                            pod["metadata"].get("labels", {}), selector)
+                    ]
+                    for key in doomed:
+                        del srv.pods[key]
+                    return self._send(200, {"kind": "Status", "status": "Success"})
+                for table, kind in (
+                    (srv.deployments, "deployments"),
+                    (srv.jobs, "jobs"),
+                    (srv.services, "services"),
+                ):
+                    if len(parts) >= 2 and parts[-2] == kind:
+                        ns, name = parts[-3], parts[-1]
+                        if (ns, name) not in table:
+                            return self._error(404, f"{kind} {name} not found")
+                        del table[(ns, name)]
+                        return self._send(200, {"kind": "Status",
+                                                "status": "Success"})
+                if parts[:3] == ["apis", "edl.tpu", "v1"] and len(parts) == 7:
+                    ns, name = parts[4], parts[6]
+                    obj = srv.trainingjobs.pop((ns, name), None)
+                    if obj is None:
+                        return self._error(404, "trainingjob not found")
+                    srv._stamp(obj, ns)
+                    srv.record_tj_event("DELETED", obj)
+                    return self._send(200, obj)
+            self._error(404, f"no DELETE route {self.path}")
+
+    return Handler
+
+
+def _merge(dst: dict, patch: dict) -> None:
+    """RFC 7386 merge patch."""
+    for key, value in patch.items():
+        if value is None:
+            dst.pop(key, None)
+        elif isinstance(value, dict) and isinstance(dst.get(key), dict):
+            _merge(dst[key], value)
+        else:
+            dst[key] = value

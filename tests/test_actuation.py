@@ -1,0 +1,188 @@
+"""Autoscaler → coordinator actuation: the elastic story's two halves, joined.
+
+VERDICT r2 gap #2's done-criterion: an e2e test where the AUTOSCALER (not a
+test helper) rescales a live 2-process job to 3 and the workers warm-restart
+into the new world (ref actuation: `pkg/autoscaler.go:339-376`; ref recovery
+narrative: `doc/boss_tutorial.md:229-241`).
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from edl_tpu.api.quantity import ResourceList
+from edl_tpu.api.types import TrainingJob
+from edl_tpu.api.validation import normalize
+from edl_tpu.controller.actuation import EXPECTED_WORLD_KEY, CoordinatorActuator
+from edl_tpu.controller.autoscaler import Autoscaler, AutoscalerConfig
+from edl_tpu.controller.cluster import NodeInfo
+from edl_tpu.controller.jobparser import parse_to_trainer
+from edl_tpu.controller.process_cluster import ProcessCluster
+from edl_tpu.coordinator import CoordinatorServer, InProcessCoordinator
+from edl_tpu.coordinator.server import ensure_built, free_port
+
+from tests.test_multihost import REPO, WORKER_SRC
+
+LAUNCHER_SRC = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from edl_tpu.launcher.launch import LaunchContext, start_trainer
+ctx = LaunchContext.from_env()
+sys.exit(start_trainer(ctx))
+"""
+
+
+def test_actuator_publishes_world_and_nudges_epoch():
+    """Unit: publish lands under EXPECTED_WORLD_KEY; nudge bumps the epoch
+    and releases parked sync waiters (via the real wire protocol)."""
+    ensure_built()
+    with CoordinatorServer() as server:
+        actuator = CoordinatorActuator()
+        actuator.set_endpoint("job", "127.0.0.1", server.port)
+        assert actuator.publish_expected_world("job", 3)
+        probe = server.client("probe")
+        assert probe.kv_get(EXPECTED_WORLD_KEY) == "3"
+        before = probe.epoch()
+        assert actuator.nudge("job")
+        assert probe.epoch() == before + 1
+        # unknown job: both no-op cleanly
+        assert not actuator.publish_expected_world("ghost", 2)
+        assert not actuator.nudge("ghost")
+
+
+def test_actuator_tracks_endpoint_from_spec():
+    job = normalize(TrainingJob.from_dict({
+        "metadata": {"name": "j1", "namespace": "ns"},
+        "spec": {"port": 7200, "trainer": {"min_instance": 1, "max_instance": 2}},
+    }))
+    actuator = CoordinatorActuator()
+    actuator.track(job)
+    assert actuator._endpoints["j1"] == ("j1-coordinator.ns", 7200)
+    # an explicit endpoint registered first wins over track()
+    actuator2 = CoordinatorActuator()
+    actuator2.set_endpoint("j1", "127.0.0.1", 9999)
+    actuator2.track(job)
+    assert actuator2._endpoints["j1"] == ("127.0.0.1", 9999)
+
+
+def test_inprocess_bump_epoch_matches_native():
+    coord = InProcessCoordinator()
+    c = coord.client("w0")
+    c.register()
+    before = int(c.register()["epoch"])
+    assert c.bump_epoch() == before + 1  # int, like CoordinatorClient's
+
+
+def test_autoscaler_rescales_live_two_process_job_to_three(tmp_path):
+    """Full loop: ProcessCluster runs 2 real trainer processes against a real
+    coordinator; the Autoscaler sees free chips, decides 2→3, publishes
+    edl/expected_world, actuates the provider (3rd process spawns), nudges the
+    epoch — and every worker warm-restarts into a world-3 job that drains the
+    queue."""
+    ensure_built()
+    jax_port = free_port()
+    ckpt = str(tmp_path / "ck")
+
+    entry_py = tmp_path / "entry.py"
+    entry_py.write_text(WORKER_SRC.format(repo=REPO, jax_port=jax_port))
+    launcher_py = tmp_path / "launcher.py"
+    launcher_py.write_text(LAUNCHER_SRC.format(repo=REPO))
+
+    with CoordinatorServer(heartbeat_ttl_sec=5.0) as server:
+        admin = server.client("admin")
+        # Enough shards that the world-2 phase outlives worker bring-up, few
+        # enough that world 3 drains them within the test budget on one core.
+        admin.add_tasks([f"mh/part-{i:05d}" for i in range(120)])
+
+        job = normalize(TrainingJob.from_dict({
+            "metadata": {"name": "asjob"},
+            "spec": {
+                "fault_tolerant": True,
+                "tpu": {"chips_per_trainer": 4},
+                "trainer": {
+                    "min_instance": 2,
+                    "max_instance": 3,
+                    "entrypoint": f"{sys.executable} {launcher_py}",
+                    "resources": {"requests": {"cpu": 1}},
+                    "env": {
+                        "EDL_COORDINATOR_ENDPOINT": server.address,
+                        "EDL_ENTRY": f"{sys.executable} {entry_py}",
+                        "CKPT_DIR": ckpt,
+                        "BATCHES_PER_SHARD": "15",
+                        # Commit early/often: the progress gate below watches
+                        # the done-counter, which completion-lag ties to
+                        # checkpoints (multihost.py checkpoint_and_commit).
+                        "CKPT_INTERVAL": "60",
+                        "EDL_TERMINATION_LOG": str(tmp_path / "term"),
+                    },
+                },
+            },
+        }))
+
+        # 3 hosts x 4 chips: room for exactly 3 trainers.
+        cluster = ProcessCluster(
+            [NodeInfo(name=f"h{i}",
+                      allocatable=ResourceList.make({"cpu": 16, "tpu": 4}))
+             for i in range(3)],
+            log_dir=str(tmp_path / "logs"),
+        )
+        trainer = parse_to_trainer(job)
+        # Worker identity comes from EDL_POD_NAME, unique per spawned pod.
+        scale_records = []
+        try:
+            cluster.create_role(job.name, "trainer", 2, trainer.requests,
+                                trainer.limits, workload=trainer)
+
+            # wait for real progress at world 2
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if int(admin.status().get("done", 0)) >= 2:
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail("world-2 job never made progress")
+
+            # THE AUTOSCALER decides and actuates the rescale.
+            actuator = CoordinatorActuator()
+            actuator.set_endpoint(job.name, "127.0.0.1", server.port)
+            scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.5))
+            scaler.actuator = actuator
+            scaler.on_scaled = lambda name, rec: scale_records.append((name, rec))
+            scaler.on_add(job)
+            scaler.start()
+            try:
+                deadline = time.time() + 60
+                while time.time() < deadline and not scale_records:
+                    time.sleep(0.2)
+            finally:
+                scaler.stop()
+            assert scale_records, "autoscaler never actuated"
+            name, record = scale_records[0]
+            assert name == "asjob"
+            assert (record.from_replicas, record.to_replicas) == (2, 3)
+            assert admin.kv_get(EXPECTED_WORLD_KEY) == "3"
+
+            # all three launchers run to completion at world 3
+            cluster.wait_all(timeout=420)
+            pods = cluster.job_pods(job.name, "trainer")
+            assert len(pods) == 3
+            assert all(p.phase == "Succeeded" for p in pods), [
+                (p.name, p.phase) for p in pods
+            ]
+            st = admin.status()
+            assert int(st["queued"]) == 0 and int(st["leased"]) == 0
+        finally:
+            cluster.shutdown()
+
+    # every worker's final incarnation reports world=3
+    finals = {}
+    for log_file in (tmp_path / "logs").iterdir():
+        lines = [l for l in log_file.read_text().splitlines()
+                 if l.startswith("METRICS ")]
+        if lines:
+            finals[log_file.name] = json.loads(lines[-1][len("METRICS "):])
+    assert len(finals) == 3, finals.keys()
+    assert all(m["world"] == 3.0 for m in finals.values()), finals

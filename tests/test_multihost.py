@@ -25,22 +25,27 @@ from edl_tpu.launcher.launch import LaunchContext
 from edl_tpu.launcher.discovery import wait_coordinator
 from edl_tpu.models import fit_a_line
 from edl_tpu.runtime import (
-    ElasticConfig, MultiHostWorker, SyntheticShardSource, distributed_init,
+    ElasticConfig, FileShardSource, MultiHostWorker, SyntheticShardSource,
+    distributed_init,
 )
 from edl_tpu.runtime.train_loop import TrainerConfig
 
 ctx = LaunchContext.from_env()
 client = wait_coordinator(ctx.coordinator_endpoint)
-client.worker = os.environ["WORKER_NAME"]
+client.worker = os.environ.get("WORKER_NAME") or os.environ["EDL_POD_NAME"]
 distributed_init(ctx, client, timeout=90.0, jax_port={jax_port})
+if os.environ.get("FILE_SHARD_ROOT"):
+    source = FileShardSource(root=os.environ["FILE_SHARD_ROOT"], batch_size=16)
+else:
+    source = SyntheticShardSource(fit_a_line.MODEL, batch_size=16,
+                                  batches_per_shard=int(os.environ.get("BATCHES_PER_SHARD", "3")))
 worker = MultiHostWorker(
     fit_a_line.MODEL,
     client,
-    SyntheticShardSource(fit_a_line.MODEL, batch_size=16,
-                         batches_per_shard=int(os.environ.get("BATCHES_PER_SHARD", "3"))),
+    source,
     ElasticConfig(
         checkpoint_dir=os.environ["CKPT_DIR"],
-        checkpoint_interval=1000,
+        checkpoint_interval=int(os.environ.get("CKPT_INTERVAL", "1000")),
         rescale_barrier_timeout=30.0,
         trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
     ),
