@@ -226,6 +226,10 @@ class TrainingJob:
     spec: TrainingJobSpec = field(default_factory=TrainingJobSpec)
     status: TrainingJobStatus = field(default_factory=TrainingJobStatus)
     labels: Dict[str, str] = field(default_factory=dict)
+    #: server-assigned object identity (K8s metadata.uid). Distinguishes two
+    #: runs of a same-named job — stamped into pods as EDL_RUN_ID so the
+    #: coordinator never resumes a previous run's state file.
+    uid: str = ""
 
     # -- predicates (ref: pkg/resource/training_job.go:189-207) ---------------
 
@@ -259,6 +263,7 @@ class TrainingJob:
             namespace=meta.get("namespace", d.get("namespace", "default")),
             spec=TrainingJobSpec.from_dict(d.get("spec")),
             labels=dict(meta.get("labels", {})),
+            uid=meta.get("uid", ""),
         )
         st = d.get("status")
         if st:
@@ -274,12 +279,15 @@ class TrainingJob:
         return job
 
     def to_dict(self) -> dict:
+        meta = {
+            "name": self.name,
+            "namespace": self.namespace,
+            "labels": dict(self.labels),
+        }
+        if self.uid:
+            meta["uid"] = self.uid
         return {
-            "metadata": {
-                "name": self.name,
-                "namespace": self.namespace,
-                "labels": dict(self.labels),
-            },
+            "metadata": meta,
             "spec": self.spec.to_dict(),
             "status": {
                 "phase": self.status.phase.value,

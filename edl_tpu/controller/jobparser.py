@@ -84,6 +84,11 @@ def make_env(job: TrainingJob, role: str) -> Dict[str, str]:
         "EDL_MESH_AXES": json.dumps(spec.parallelism),
         "EDL_CHECKPOINT_DIR": spec.checkpoint_dir,
         "EDL_CHECKPOINT_INTERVAL": str(spec.checkpoint_interval),
+        # Run identity for the coordinator's state file: the K8s object UID
+        # when the apiserver assigned one, else namespace/name (in-memory
+        # stores). Keeps a re-created job from resuming its predecessor's
+        # done-set out of a reused workspace volume.
+        "EDL_RUN_ID": job.uid or f"{job.namespace}/{job.name}",
     }
     replica: ReplicaSpec = spec.trainer if role == ROLE_TRAINER else spec.coordinator
     if replica.entrypoint:

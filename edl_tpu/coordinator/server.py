@@ -55,17 +55,24 @@ class CoordinatorServer:
         port: Optional[int] = None,
         task_lease_sec: float = 16.0,  # ref: -task-timout-dur 16s
         heartbeat_ttl_sec: float = 10.0,
-        host: str = "0.0.0.0",
+        host: str = "127.0.0.1",
         state_file: Optional[str] = None,
+        run_id: Optional[str] = None,
     ):
         self.port = port or free_port()
         self.task_lease_sec = task_lease_sec
         self.heartbeat_ttl_sec = heartbeat_ttl_sec
+        #: loopback by default — the protocol is unauthenticated, so binding
+        #: beyond loopback is an explicit deployment decision (the pod
+        #: launcher passes host="0.0.0.0": cross-host trainers must dial in).
         self.host = host
-        #: snapshot path for queue/done/kv/epoch durability; a restarted
-        #: server with the same state_file resumes instead of replaying the
-        #: whole dataset (the reference's etcd-sidecar role).
+        #: durability log path for queue/done/kv/epoch; a restarted server
+        #: with the same state_file (and run_id) resumes instead of replaying
+        #: the whole dataset (the reference's etcd-sidecar role).
         self.state_file = state_file
+        #: identity stamped into the state file; a mismatched file (another
+        #: run's leftovers in the same workspace) is discarded, not resumed.
+        self.run_id = run_id
         self._proc: Optional[subprocess.Popen] = None
 
     @property
@@ -83,6 +90,8 @@ class CoordinatorServer:
         ]
         if self.state_file:
             argv += ["--state-file", self.state_file]
+        if self.run_id:
+            argv += ["--run-id", self.run_id]
         self._proc = subprocess.Popen(
             argv,
             stdout=subprocess.DEVNULL,

@@ -84,6 +84,10 @@ class LaunchContext:
     #: default under the workspace, so a restarted coordinator pod with any
     #: persistent volume resumes instead of replaying the dataset.
     state_file: str = ""
+    #: identity of this job RUN (the K8s object UID when deployed). Stamped
+    #: into the coordinator state file so a fresh run in a reused workspace
+    #: discards the previous run's done-set instead of silently "completing".
+    run_id: str = ""
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "LaunchContext":
@@ -107,6 +111,7 @@ class LaunchContext:
             checkpoint_interval=int(e.get("EDL_CHECKPOINT_INTERVAL", "1000")),
             termination_log=e.get("EDL_TERMINATION_LOG", "/dev/termination-log"),
             state_file=e.get("EDL_STATE_FILE", ""),
+            run_id=e.get("EDL_RUN_ID", ""),
         )
 
     @property
@@ -160,7 +165,16 @@ def start_coordinator(ctx: LaunchContext, block: bool = True):
     state_file = ctx.state_file or os.path.join(
         ctx.workspace or ".", f"{ctx.job_name}-coordinator-state.jsonl"
     )
-    server = CoordinatorServer(port=ctx.port, state_file=state_file)
+    # host="0.0.0.0" is deliberate and launcher-only: trainer pods on other
+    # hosts dial the coordinator service, so the pod role must expose the
+    # port; the binary itself defaults to loopback (unauthenticated protocol).
+    # run_id keeps a reused workspace's stale state file from being resumed.
+    server = CoordinatorServer(
+        port=ctx.port,
+        host="0.0.0.0",
+        state_file=state_file,
+        run_id=ctx.run_id or f"{ctx.namespace}/{ctx.job_name}",
+    )
     server.start()
     if ctx.data_shards:
         # Idempotent across restarts: the server dedups against its restored

@@ -18,7 +18,7 @@ from edl_tpu.runtime.data import (
 from edl_tpu.runtime.distributed import DistributedIdentity, distributed_init
 from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
 from edl_tpu.runtime.multihost import MultiHostWorker
-from edl_tpu.runtime.wire import WireCodec
+from edl_tpu.runtime.wire import KVCodecChannel, WireCodec, WireRestartRequired
 
 __all__ = [
     "Checkpointer",
@@ -26,6 +26,7 @@ __all__ = [
     "ElasticConfig",
     "ElasticWorker",
     "FileShardSource",
+    "KVCodecChannel",
     "LeaseReader",
     "MultiHostWorker",
     "RescaleEvent",
@@ -34,6 +35,7 @@ __all__ = [
     "Trainer",
     "TrainerConfig",
     "WireCodec",
+    "WireRestartRequired",
     "abstract_like",
     "distributed_init",
     "live_state_specs",

@@ -203,7 +203,16 @@ class ElasticWorker:
             world = self._world
             rescale_t0 = time.perf_counter()
             mesh = self._build_mesh(world)
-            trainer = Trainer(self.model, mesh, self.config.trainer)
+            codec_channel = None
+            if self.config.trainer.wire_transport:
+                from edl_tpu.runtime.wire import KVCodecChannel
+
+                # Single-host worker (one process): in-place widening is safe,
+                # but persisting the widen floor through the coordinator means
+                # a restarted incarnation never re-learns an old overflow.
+                codec_channel = KVCodecChannel(self.client, self._epoch)
+            trainer = Trainer(self.model, mesh, self.config.trainer,
+                              codec_channel=codec_channel)
             if self.profiler is not None:
                 # The first step on a fresh mesh recompiles (20-40 s on TPU);
                 # keep it out of steady-state summaries.

@@ -97,6 +97,11 @@ class MultiHostWorker:
         #: rank 0 only: shards consumed since the last durable checkpoint —
         #: their leases are held open until a checkpoint covers them.
         self._uncommitted: List[str] = []
+        #: rank 0 only: shards that produced a zero-step round once already
+        #: (no-metadata path). First zero-observation requeues the shard —
+        #: rank 0 cannot know whether OTHER ranks trained it; a second zero
+        #: round completes it as genuinely empty (no livelock).
+        self._zero_seen: set = set()
         #: rank 0 only: published round-plan indices not yet GC'd, and the
         #: last round known to have contained a collective (training step or
         #: checkpoint). A collective in round R proves every rank consumed
@@ -277,7 +282,16 @@ class MultiHostWorker:
         epoch = int(info["epoch"])
 
         mesh = self._build_mesh()
-        trainer = Trainer(self.model, mesh, self.config.trainer)
+        codec_channel = None
+        if self.config.trainer.wire_transport:
+            from edl_tpu.runtime.wire import KVCodecChannel
+
+            # Epoch-scoped: a rescale's new incarnation renegotiates the
+            # codec from scratch (possibly under a new rank 0) while the
+            # widen floor persists across epochs.
+            codec_channel = KVCodecChannel(self.client, epoch)
+        trainer = Trainer(self.model, mesh, self.config.trainer,
+                          codec_channel=codec_channel)
         if self.profiler is not None:
             self.profiler.mark_warmup()
         state = self._restore_or_init(trainer)
@@ -336,16 +350,25 @@ class MultiHostWorker:
                 if self.profiler is not None:
                     self.profiler.step(len(next(iter(batch.values()))))
 
+            from edl_tpu.runtime.wire import WireRestartRequired
+
             steps = msg.get("steps")
-            if steps is None:
-                # No batch_count metadata: shards must align by construction.
-                for batch in self.source.read(shard):
-                    _train_one(batch)
-            else:
-                # Run exactly `steps` collective steps; cycle a shorter
-                # shard's batches so every rank stays in lockstep.
-                for batch in self._padded_batches(shard, tasks, steps):
-                    _train_one(batch)
+            try:
+                if steps is None:
+                    # No batch_count metadata: shards must align by construction.
+                    for batch in self.source.read(shard):
+                        _train_one(batch)
+                else:
+                    # Run exactly `steps` collective steps; cycle a shorter
+                    # shard's batches so every rank stays in lockstep.
+                    for batch in self._padded_batches(shard, tasks, steps):
+                        _train_one(batch)
+            except WireRestartRequired as e:
+                # A batch overflowed the gang-negotiated wire codec; the
+                # widened floor is already published. Same recovery as a
+                # rescale: gang warm-restart, renegotiate from the floor.
+                log.warning("wire codec overflow (%s); gang restart", e)
+                self._exit_for_restart()
             if rank == 0 and ran_steps > 0:
                 # hwm only moves when a collective actually ran this round: a
                 # zero-step round has no barrier, so advancing it would reopen
@@ -353,13 +376,27 @@ class MultiHostWorker:
                 self._uncommitted.extend(dict.fromkeys(tasks))  # dedup tail dups
                 self._collective_hwm = rnd  # train steps are global collectives
             elif rank == 0:
-                # Only reachable on the no-metadata path with all-empty reads:
-                # no collective ran, so complete the shards immediately (they
-                # carry no updates a checkpoint must cover) rather than letting
-                # them requeue forever.
-                log.warning("round %d trained 0 steps; completing %s", rnd, tasks)
+                # Only reachable on the no-metadata path when rank 0's OWN
+                # read yielded nothing. Completing on that local observation
+                # alone would be at-most-once: another rank may have trained
+                # updates from these shards that no checkpoint covers yet. So
+                # the first zero round requeues them for replay; a shard that
+                # comes back zero a SECOND time is genuinely empty (the
+                # no-metadata contract says shards align by construction) and
+                # completes, bounding the requeue loop.
                 for t in dict.fromkeys(tasks):
-                    self.client.complete_task(t)
+                    if t in self._zero_seen:
+                        log.warning(
+                            "round %d: shard %r empty twice; completing", rnd, t
+                        )
+                        self.client.complete_task(t)
+                    else:
+                        log.warning(
+                            "round %d: shard %r trained 0 steps; requeueing "
+                            "for replay", rnd, t
+                        )
+                        self._zero_seen.add(t)
+                        self.client.fail_task(t)
             if int(state.step) - last_ckpt_step >= self.config.checkpoint_interval:
                 # Deterministic across ranks (lockstep step counter), so every
                 # process enters the collective save together.

@@ -71,11 +71,22 @@ class Trainer:
     (`edl_tpu.runtime.elastic`).
     """
 
-    def __init__(self, model: Model, mesh: Mesh, config: Optional[TrainerConfig] = None):
+    def __init__(
+        self,
+        model: Model,
+        mesh: Mesh,
+        config: Optional[TrainerConfig] = None,
+        codec_channel: Optional[Any] = None,
+    ):
         self.model = model
         self.mesh = mesh
         self.config = config or TrainerConfig()
         self.opt = _make_optimizer(self.config)
+        #: multi-process codec agreement (edl_tpu.runtime.wire.KVCodecChannel).
+        #: Required for wire_transport in multi-process jobs: every process
+        #: must jit the identical decode program, so the codec is negotiated
+        #: through the coordinator KV instead of inferred per-process.
+        self.codec_channel = codec_channel
 
         def _step(state: TrainState, batch: Dict[str, jax.Array]) -> Tuple[TrainState, jax.Array]:
             loss, grads = jax.value_and_grad(model.loss_fn)(state.params, batch, mesh)
@@ -110,37 +121,68 @@ class Trainer:
         )
 
     def place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-        if self.config.wire_transport and jax.process_count() > 1:
-            # Every process must jit the IDENTICAL program; per-process codec
-            # inference (and widening) from local batches would diverge them
-            # and mis-pair collectives. Until codec negotiation is broadcast
-            # through the coordinator, multi-process jobs ship raw batches.
+        multiproc = jax.process_count() > 1
+        if self.config.wire_transport and multiproc and self.codec_channel is None:
+            # Per-process codec inference from local batches would diverge the
+            # jitted programs and mis-pair collectives; without a negotiation
+            # channel the only safe transport is raw.
             if not getattr(self, "_warned_wire_multiproc", False):
                 self._warned_wire_multiproc = True
                 import logging
 
                 logging.getLogger("edl_tpu.trainer").warning(
                     "wire_transport disabled: multi-process jobs need a "
-                    "globally agreed codec"
+                    "codec_channel (KVCodecChannel) for a globally agreed codec"
                 )
         elif self.config.wire_transport:
-            from edl_tpu.runtime.wire import WireCodec, WireOverflowError
+            from edl_tpu.runtime.wire import (
+                WireCodec, WireOverflowError, WireRestartRequired,
+            )
 
             if self._codec is None:
-                self._codec = WireCodec.infer(
-                    batch,
-                    no_lossy_keys=(*self.model.label_keys, *self.config.wire_raw_keys),
-                )
+                if not multiproc:
+                    self._codec = WireCodec.infer(
+                        batch,
+                        no_lossy_keys=(*self.model.label_keys,
+                                       *self.config.wire_raw_keys),
+                    )
+                    if self.codec_channel is not None:
+                        # Single-process jobs still honor the persistent widen
+                        # floor so a restart cannot re-learn old overflows.
+                        self._codec = self._codec.apply_floor(
+                            self.codec_channel.floor()
+                        )
+                elif jax.process_index() == 0:
+                    inferred = WireCodec.infer(
+                        batch,
+                        no_lossy_keys=(*self.model.label_keys,
+                                       *self.config.wire_raw_keys),
+                    )
+                    self._codec = self.codec_channel.publish(inferred)
+                else:
+                    self._codec = self.codec_channel.fetch()
                 self._rebuild_wire_jit()
             while True:
                 try:
                     batch = self._codec.encode(batch)
                     break
                 except WireOverflowError as e:
-                    # A later batch exceeded the example batch's range: widen
-                    # that key's encoding and re-jit (bounded — at most two
-                    # widenings per key, then it is raw).
+                    if multiproc:
+                        # In-place widening would desync the gang (peers keep
+                        # the old decode-jit). Publish the widened floor and
+                        # demand a warm restart; renegotiation starts from the
+                        # floor, so this overflow cannot recur.
+                        self.codec_channel.raise_floor(
+                            e.key, self._codec.widen(e.key).keys[e.key].encoding
+                        )
+                        raise WireRestartRequired(e.key) from e
+                    # Single process: widen that key's encoding and re-jit
+                    # (bounded — at most two widenings per key, then raw).
                     self._codec = self._codec.widen(e.key)
+                    if self.codec_channel is not None:
+                        self.codec_channel.raise_floor(
+                            e.key, self._codec.keys[e.key].encoding
+                        )
                     self._rebuild_wire_jit()
         specs = (
             self.model.batch_spec(self.mesh)

@@ -25,6 +25,8 @@ from one example batch is safe.
 
 from __future__ import annotations
 
+import json
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Iterable, Optional
 
@@ -32,9 +34,12 @@ import jax.numpy as jnp
 import numpy as np
 from ml_dtypes import bfloat16 as np_bfloat16
 
-__all__ = ["WireCodec", "WireOverflowError"]
+__all__ = ["WireCodec", "WireOverflowError", "KVCodecChannel", "WireRestartRequired"]
 
 _U24_MAX = (1 << 24) - 1
+
+#: widths ordered narrow -> wide, for floor comparisons
+_WIDTH_ORDER = {"u8": 0, "u24": 1, "bf16": 1, "raw": 2}
 
 
 class WireOverflowError(ValueError):
@@ -153,6 +158,39 @@ class WireCodec:
             raise KeyError(f"{key}: encoding {kc.encoding!r} cannot widen")
         return WireCodec({**self.keys, key: wider})
 
+    # -- cross-process agreement ----------------------------------------------
+
+    def to_spec(self) -> str:
+        """JSON wire-spec: enough for a peer process to rebuild the IDENTICAL
+        codec (and therefore the identical decode-jit — multi-process SPMD
+        requires every process to compile the same program)."""
+        return json.dumps(
+            {k: {"e": kc.encoding, "d": np.dtype(kc.dtype).str}
+             for k, kc in sorted(self.keys.items())},
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "WireCodec":
+        return cls({
+            k: _KeyCodec(v["e"], np.dtype(v["d"]))
+            for k, v in json.loads(spec).items()
+        })
+
+    def apply_floor(self, floor: Dict[str, str]) -> "WireCodec":
+        """Return a codec whose int encodings are at least as wide as
+        ``floor`` (key -> encoding). The floor records widths that previous
+        incarnations learned the hard way (a batch overflowed), so a
+        renegotiated codec cannot repeat the overflow."""
+        keys = dict(self.keys)
+        for k, enc in floor.items():
+            kc = keys.get(k)
+            if kc is None or kc.encoding in ("raw", "bf16"):
+                continue
+            if _WIDTH_ORDER.get(enc, 0) > _WIDTH_ORDER[kc.encoding]:
+                keys[k] = _KeyCodec(enc, kc.dtype)
+        return WireCodec(keys)
+
     def is_encoded(self, batch: Dict[str, Any]) -> bool:
         """True if ``batch`` looks wire-encoded (used to route jit variants)."""
         for name, kc in self.keys.items():
@@ -165,3 +203,104 @@ class WireCodec:
 
     def wire_bytes(self, batch: Dict[str, np.ndarray]) -> int:
         return sum(int(np.asarray(v).nbytes) for v in self.encode(batch).values())
+
+
+class WireRestartRequired(RuntimeError):
+    """Multi-process codec agreement broke (a batch overflowed the negotiated
+    codec, or rank 0 died before publishing one). In-place repair would
+    desynchronize the gang (peers would keep the old decode-jit and mis-pair
+    collectives), so every process must warm-restart and renegotiate — the
+    same gang-restart path a rescale takes."""
+
+    def __init__(self, key: str, message: Optional[str] = None):
+        super().__init__(
+            message
+            or f"wire key {key!r} overflowed the negotiated codec; widened "
+               "floor published — exit for gang warm-restart to renegotiate"
+        )
+        self.key = key
+
+
+class KVCodecChannel:
+    """Codec agreement for multi-process jobs, over the coordinator KV.
+
+    Every process must jit the IDENTICAL decode program, so the codec cannot
+    be inferred per-process from local batches (ranges differ; the jits would
+    diverge and mis-pair collectives). Protocol:
+
+    - rank 0 infers from its first batch, applies the persistent widen
+      floor, and publishes the spec under an EPOCH-SCOPED key — a rescale
+      (new epoch, possibly new rank 0) renegotiates from scratch;
+    - other ranks poll that key and build the same codec;
+    - an overflow on ANY rank raises that key's width in the (epoch-less)
+      floor and triggers a gang warm-restart; the renegotiated codec starts
+      from the floor, so the overflow cannot recur (u8 -> u24 -> raw, at
+      most two restarts per key, ever).
+
+    The reference's analog is static: every trainer got the same dense/sparse
+    transport config stamped by the job parser (`pkg/jobparser.go:232-247`);
+    here the agreement is negotiated once and pinned the same way.
+
+    One KV key holds {"epoch": N, "spec": ...}: each incarnation's publish
+    overwrites its predecessor's, so dead epochs never accumulate in the
+    coordinator KV or its durable snapshots (the round-plan keys need
+    explicit GC; this one is self-compacting).
+    """
+
+    SPEC_KEY = "edl/wire_codec"
+    FLOOR_KEY = "edl/wire_floor"
+
+    def __init__(self, client, epoch: int):
+        self.client = client
+        self.epoch = int(epoch)
+
+    def floor(self) -> Dict[str, str]:
+        raw = self.client.kv_get(self.FLOOR_KEY)
+        return json.loads(raw) if raw else {}
+
+    def publish(self, codec: "WireCodec") -> "WireCodec":
+        """Rank 0: pin the (floored) codec for this epoch; returns it."""
+        floored = codec.apply_floor(self.floor())
+        self.client.kv_put(
+            self.SPEC_KEY,
+            json.dumps({"epoch": self.epoch, "spec": floored.to_spec()}),
+        )
+        return floored
+
+    def fetch(self, timeout: float = 60.0) -> "WireCodec":
+        """Ranks > 0: block until rank 0 publishes THIS epoch's codec.
+
+        Heartbeats while polling — negotiation can outlast the coordinator's
+        heartbeat TTL (rank 0 may be opening a cold shard), and a silent
+        waiter would be TTL-evicted, bumping the epoch and restarting the
+        gang for nothing. A timeout means rank 0 died pre-publish; recovery
+        is the same gang warm-restart a rescale takes, so that is what the
+        raised error demands.
+        """
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            raw = self.client.kv_get(self.SPEC_KEY)
+            if raw:
+                msg = json.loads(raw)
+                if int(msg.get("epoch", -1)) == self.epoch:
+                    return WireCodec.from_spec(msg["spec"])
+            self.client.heartbeat()
+            time.sleep(0.05)
+        raise WireRestartRequired(
+            "",
+            message=f"no wire codec published for epoch {self.epoch} within "
+                    f"{timeout}s (rank 0 died pre-publish?) — exit for gang "
+                    "warm-restart",
+        )
+
+    def raise_floor(self, key: str, encoding: str) -> None:
+        """Record that ``key`` needs at least ``encoding`` before restarting.
+
+        Read-modify-write is safe enough here: floors only ever widen, and
+        the restart path re-applies them idempotently — a lost concurrent
+        update costs at most one extra restart for the other key.
+        """
+        floor = self.floor()
+        if _WIDTH_ORDER.get(encoding, 0) > _WIDTH_ORDER.get(floor.get(key, "u8"), -1):
+            floor[key] = encoding
+            self.client.kv_put(self.FLOOR_KEY, json.dumps(floor, sort_keys=True))

@@ -21,16 +21,22 @@
 // trainers see the new epoch on their next heartbeat and enter the
 // checkpoint -> rebuild-mesh -> restore rescale path (edl_tpu.runtime.elastic).
 //
-// Durability: --state-file snapshots the task queue (todo+leased merged, a
+// Durability: --state-file persists the task queue (todo+leased merged, a
 // restart requeues live leases for at-least-once replay), the done-set, the
-// KV namespace, and the membership epoch to disk on mutation, restoring at
-// startup — replacing the reference's etcd-sidecar persistence
-// (pkg/jobparser.go:167-184). Without it a restart reseeds the queue with an
-// empty done-set and the whole dataset replays.
+// KV namespace, and the membership epoch — replacing the reference's
+// etcd-sidecar persistence (pkg/jobparser.go:167-184). The file is JSONL:
+// a full snapshot plus appended delta records (one per mutation), fsynced
+// BEFORE the mutating request is acknowledged, so a client that saw
+// complete_task/kv_put succeed can rely on the write surviving kill -9.
+// The delta log compacts back into a snapshot when it dwarfs the live state.
+// --run-id stamps the file with the job run's identity: a coordinator booted
+// with a different run-id discards the file instead of resuming another
+// run's done-set (which would silently "complete" a fresh job untrained).
 //
 // Build: make (or cmake).
 // Run: edl-coordinator --port 7164 [--host 0.0.0.0] [--task-lease-sec 16]
 //      [--heartbeat-ttl-sec 10] [--state-file /path/state.jsonl]
+//      [--run-id ID]
 
 #include <arpa/inet.h>
 #include <errno.h>
@@ -299,9 +305,9 @@ struct Conn {
 class Coordinator {
  public:
   Coordinator(double task_lease_sec, double heartbeat_ttl_sec,
-              std::string state_file = "")
+              std::string state_file = "", std::string run_id = "")
       : task_lease_sec_(task_lease_sec), heartbeat_ttl_sec_(heartbeat_ttl_sec),
-        state_file_(std::move(state_file)) {
+        state_file_(std::move(state_file)), run_id_(std::move(run_id)) {
     if (!state_file_.empty()) load_state();
   }
 
@@ -321,14 +327,51 @@ class Coordinator {
 
   void on_disconnect(int fd);
 
+  // Fail fast on a misconfigured state path: with ack-after-durability a
+  // never-writable log would hold every reply forever; a pod that cannot
+  // persist must crash loudly at boot, not run silently non-durable.
+  bool state_writable() {
+    if (state_file_.empty()) return true;
+    if (!append_fp_) append_fp_ = fopen(state_file_.c_str(), "a");
+    return append_fp_ != nullptr;
+  }
+
   // Persist durable state (queue/done/kv/epoch) if anything changed since the
-  // last save. Called from the event loop after each batch of requests.
-  void maybe_save_state();
+  // last save. Called from the event loop after each batch of requests and
+  // BEFORE their replies flush: a client that saw a mutating op succeed can
+  // rely on the write having hit disk (ack-after-durability). Returns false
+  // while un-durable mutations are still pending — the caller must then hold
+  // reply flushes so no ack outruns the disk.
+  bool maybe_save_state();
 
  private:
   void load_state();
-  void save_state();
-  void mark_dirty() { dirty_ = true; }
+  bool save_snapshot();
+  // Delta records: one JSONL line per mutation, appended + fsynced by
+  // maybe_save_state(). Pending lines are retained (and retried) when a
+  // write fails, never silently dropped.
+  void record(const std::string& line) {
+    if (!state_file_.empty()) pending_ += line;
+  }
+  void record_epoch() {
+    record(JsonWriter().field("k", "meta").field("epoch", (double)epoch_)
+               .field("run_id", run_id_).done());
+  }
+  void record_done(const std::string& task) {
+    record(JsonWriter().field("k", "done")
+               .field("tasks", std::vector<std::string>{task}).done());
+  }
+  void record_todo(const std::vector<std::string>& tasks) {
+    if (!tasks.empty())
+      record(JsonWriter().field("k", "todo").field("tasks", tasks).done());
+  }
+  void record_kv(const std::string& key, const std::string& value) {
+    record(JsonWriter().field("k", "kv").field("key", key)
+               .field("value", value).done());
+  }
+  void record_kv_del(const std::string& key) {
+    record(JsonWriter().field("k", "kvdel").field("key", key).done());
+  }
   std::string op_register(const JsonObject& req);
   std::string op_heartbeat(const JsonObject& req);
   std::string op_leave(const JsonObject& req);
@@ -347,7 +390,7 @@ class Coordinator {
   std::string op_status();
 
   // Epoch is persisted so monotonicity survives restarts.
-  void bump_epoch() { epoch_++; mark_dirty(); }
+  void bump_epoch() { epoch_++; record_epoch(); }
   // Release all parked sync waiters: ok=true when the epoch rendezvous
   // completed, ok=false (resync) when membership moved underneath them.
   void release_sync(bool ok);
@@ -382,20 +425,28 @@ class Coordinator {
   std::map<std::string, std::string> kv_;
   std::vector<std::pair<int, std::string>> deferred_;
   std::string state_file_;
-  bool dirty_ = false;
+  std::string run_id_;
+  FILE* append_fp_ = nullptr;      // state file held open for delta appends
+  std::string pending_;            // delta lines not yet durable
+  long long appended_records_ = 0; // deltas since the last snapshot
+  bool need_snapshot_ = false;     // e.g. run-id mismatch discarded the file
 };
 
-// Durable state is JSON-lines so it reuses the wire parser/writer:
-//   {"k":"meta","epoch":N}
+// Durable state is JSON-lines so it reuses the wire parser/writer. A file is
+// a snapshot prefix plus appended delta records; load replays them in order:
+//   {"k":"meta","epoch":N,"run_id":R}
 //   {"k":"todo","tasks":[...]}      (todo + live leases: restart requeues)
 //   {"k":"done","tasks":[...]}
 //   {"k":"kv","key":K,"value":V}    (one line per entry)
-void Coordinator::save_state() {
+//   {"k":"kvdel","key":K}           (delta only)
+bool Coordinator::save_snapshot() {
+  if (append_fp_) { fclose(append_fp_); append_fp_ = nullptr; }
   std::string tmp = state_file_ + ".tmp";
   FILE* f = fopen(tmp.c_str(), "w");
-  if (!f) { perror("state-file open"); return; }
+  if (!f) { perror("state-file open"); return false; }
   std::string out;
-  out += JsonWriter().field("k", "meta").field("epoch", (double)epoch_).done();
+  out += JsonWriter().field("k", "meta").field("epoch", (double)epoch_)
+             .field("run_id", run_id_).done();
   std::vector<std::string> todo(todo_.begin(), todo_.end());
   // Live leases are worker-held state; after a restart those workers'
   // connections (and ranks) are gone, so their tasks go back to the queue —
@@ -410,63 +461,156 @@ void Coordinator::save_state() {
   ok = fflush(f) == 0 && ok;
   ok = fsync(fileno(f)) == 0 && ok;
   fclose(f);
-  if (!ok) { fprintf(stderr, "state-file write failed\n"); return; }
-  if (rename(tmp.c_str(), state_file_.c_str()) != 0) perror("state-file rename");
+  if (!ok) { fprintf(stderr, "state-file write failed\n"); return false; }
+  if (rename(tmp.c_str(), state_file_.c_str()) != 0) {
+    perror("state-file rename");
+    return false;
+  }
+  appended_records_ = 0;
+  return true;
 }
 
 void Coordinator::load_state() {
   FILE* f = fopen(state_file_.c_str(), "r");
-  if (!f) return;  // first boot: nothing to restore
+  if (!f) {
+    // First boot of this run: stamp the (empty) log with our identity so a
+    // restart can tell whose state it is resuming.
+    record_epoch();
+    return;
+  }
   std::string content;
   char buf[65536];
   size_t n;
   while ((n = fread(buf, 1, sizeof buf, f)) > 0) content.append(buf, n);
   fclose(f);
+  // Two-phase replay: deltas mean a task can appear in a "todo" line and a
+  // later "done" line — collect everything first, then rebuild the queue
+  // excluding completed work.
+  std::vector<std::string> todo_order;
+  std::set<std::string> todo_seen;
+  std::string file_run_id;
+  long long file_epoch = 0;
+  long long file_records = 0;
+  int restored_kv = 0;
   size_t pos = 0;
-  int restored_tasks = 0, restored_kv = 0;
   while (pos < content.size()) {
     size_t nl = content.find('\n', pos);
     if (nl == std::string::npos) nl = content.size();
     std::string line = content.substr(pos, nl - pos);
     pos = nl + 1;
     if (line.empty()) continue;
+    file_records++;
     JsonObject obj;
     JsonParser parser(line);
     if (!parser.parse_object(&obj)) continue;
     std::string kind = get_str(obj, "k");
     if (kind == "meta") {
-      epoch_ = (long long)get_num(obj, "epoch", 0);
+      file_epoch = std::max(file_epoch, (long long)get_num(obj, "epoch", 0));
+      std::string rid = get_str(obj, "run_id");
+      if (!rid.empty()) file_run_id = rid;
     } else if (kind == "todo" || kind == "done") {
       auto it = obj.find("tasks");
       if (it == obj.end() || it->second.kind != JsonValue::kStrArray) continue;
       for (auto& t : it->second.arr) {
         if (kind == "done") {
           done_.insert(t);
-        } else if (!done_.count(t) && !todo_set_.count(t)) {
-          todo_.push_back(t);
-          todo_set_.insert(t);
-          restored_tasks++;
+        } else if (todo_seen.insert(t).second) {
+          todo_order.push_back(t);
         }
       }
     } else if (kind == "kv") {
       kv_[get_str(obj, "key")] = get_str(obj, "value");
       restored_kv++;
+    } else if (kind == "kvdel") {
+      kv_.erase(get_str(obj, "key"));
+    }
+  }
+  // Run identity check: resuming ANOTHER run's file would restore its
+  // done-set and silently "complete" this run having trained nothing. An
+  // un-stamped file is equally unidentifiable — discard that too. The epoch
+  // is kept monotonic either way so stale clients can never see it move
+  // backwards.
+  if (!run_id_.empty() && file_run_id != run_id_) {
+    fprintf(stderr,
+            "edl-coordinator: state file %s belongs to run '%s' (this is run "
+            "'%s'); discarding its queue/done/kv\n",
+            state_file_.c_str(), file_run_id.c_str(), run_id_.c_str());
+    done_.clear();
+    kv_.clear();
+    epoch_ = file_epoch + 1;
+    need_snapshot_ = true;  // rewrite the file under our identity
+    return;
+  }
+  for (auto& t : todo_order) {
+    if (!done_.count(t)) {
+      todo_.push_back(t);
+      todo_set_.insert(t);
     }
   }
   // A restart IS a membership event (every registration is gone): bump the
   // epoch so reconnecting workers observe the move and re-rendezvous rather
   // than trusting pre-restart ranks.
-  epoch_++;
-  dirty_ = true;
+  epoch_ = file_epoch + 1;
+  record_epoch();
+  // Seed the compaction counter from the replayed history: a counter that
+  // restarted at 0 every boot would let a periodically-restarting
+  // coordinator grow the log ~one compaction window per incarnation, forever
+  // (O(total mutations ever) disk + parse time).
+  appended_records_ = file_records;
   fprintf(stderr,
-          "edl-coordinator restored state: epoch=%lld todo=%d done=%zu kv=%d\n",
-          epoch_, restored_tasks, done_.size(), restored_kv);
+          "edl-coordinator restored state: epoch=%lld todo=%zu done=%zu kv=%d\n",
+          epoch_, todo_.size(), done_.size(), restored_kv);
 }
 
-void Coordinator::maybe_save_state() {
-  if (state_file_.empty() || !dirty_) return;
-  save_state();
-  dirty_ = false;
+bool Coordinator::maybe_save_state() {
+  if (state_file_.empty()) return true;
+  if (need_snapshot_) {
+    if (!save_snapshot()) return false;  // retried next iteration; pending_ kept
+    need_snapshot_ = false;
+    pending_.clear();  // snapshot already contains everything pending said
+    return true;
+  }
+  if (pending_.empty()) return true;
+  // Compact once the delta log dwarfs a fresh snapshot: O(live state) rewrite
+  // amortized over >= as many mutations, instead of the old O(dataset)
+  // full rewrite on EVERY dirty event-loop iteration.
+  long long base = (long long)(todo_.size() + leased_.size() + done_.size() +
+                               kv_.size()) + 1;
+  if (appended_records_ > 1024 && appended_records_ > 2 * base) {
+    if (save_snapshot()) {
+      pending_.clear();
+      return true;
+    }
+    // Snapshot failed: fall through and keep appending — durability first.
+  }
+  if (!append_fp_) {
+    append_fp_ = fopen(state_file_.c_str(), "a");
+    if (!append_fp_) { perror("state-file append open"); return false; }  // retry
+  }
+  long long nrec = 0;
+  for (char c : pending_) nrec += (c == '\n');
+  fseeko(append_fp_, 0, SEEK_END);
+  off_t pre_append = ftello(append_fp_);  // rollback point for partial writes
+  bool ok = fwrite(pending_.data(), 1, pending_.size(), append_fp_) == pending_.size();
+  ok = fflush(append_fp_) == 0 && ok;
+  ok = fsync(fileno(append_fp_)) == 0 && ok;
+  if (!ok) {
+    // Keep pending_ — the deltas stay queued until a write succeeds, so a
+    // transient failure cannot silently drop acknowledged-later mutations.
+    // A failed fwrite/fflush may have left a PARTIAL line on disk; truncate
+    // back to the pre-append offset, otherwise the retry would concatenate
+    // the fragment with a fresh copy of the same record into one garbage
+    // line that load_state() would silently skip.
+    fprintf(stderr, "state-file append failed (will retry)\n");
+    fclose(append_fp_);
+    append_fp_ = nullptr;
+    if (pre_append >= 0 && truncate(state_file_.c_str(), pre_append) != 0)
+      perror("state-file truncate");
+    return false;
+  }
+  appended_records_ += nrec;
+  pending_.clear();
+  return true;
 }
 
 void Coordinator::release_sync(bool ok) {
@@ -588,13 +732,15 @@ std::string Coordinator::op_add_tasks(const JsonObject& req) {
   if (it == req.end() || it->second.kind != JsonValue::kStrArray)
     return JsonWriter().field("ok", false).field("error", "tasks array required").done();
   int added = 0;
+  std::vector<std::string> fresh;
   for (auto& t : it->second.arr) {
     if (done_.count(t) || leased_.count(t) || todo_set_.count(t)) continue;
     todo_.push_back(t);
     todo_set_.insert(t);
+    fresh.push_back(t);
     added++;
   }
-  if (added) mark_dirty();
+  record_todo(fresh);
   return JsonWriter().field("ok", true).field("added", (double)added)
       .field("queued", (double)todo_.size()).done();
 }
@@ -626,7 +772,7 @@ std::string Coordinator::op_complete_task(const JsonObject& req) {
     return JsonWriter().field("ok", false).field("error", "lease not owned").done();
   leased_.erase(it);
   done_.insert(task);
-  mark_dirty();
+  record_done(task);
   return JsonWriter().field("ok", true).field("done", (double)done_.size())
       .field("queued", (double)todo_.size()).done();
 }
@@ -700,7 +846,7 @@ std::string Coordinator::op_kv_put(const JsonObject& req) {
   std::string key = get_str(req, "key");
   if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
   kv_[key] = get_str(req, "value");
-  mark_dirty();
+  record_kv(key, kv_[key]);
   return JsonWriter().field("ok", true).done();
 }
 
@@ -714,7 +860,8 @@ std::string Coordinator::op_kv_get(const JsonObject& req) {
 }
 
 std::string Coordinator::op_kv_del(const JsonObject& req) {
-  if (kv_.erase(get_str(req, "key"))) mark_dirty();
+  std::string del_key = get_str(req, "key");
+  if (kv_.erase(del_key)) record_kv_del(del_key);
   return JsonWriter().field("ok", true).done();
 }
 
@@ -734,7 +881,7 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   }
   cur += delta;
   kv_[key] = std::to_string(cur);
-  mark_dirty();
+  record_kv(key, kv_[key]);
   return JsonWriter().field("ok", true).field("value", (double)cur).done();
 }
 
@@ -819,8 +966,11 @@ int make_listener(const char* host, int port) {
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  // Default 0.0.0.0: trainers on OTHER hosts dial the coordinator's service
-  // address, so a loopback-only bind would make multi-host jobs undialable.
+  // Default 127.0.0.1: the protocol is unauthenticated, so exposure beyond
+  // loopback must be an explicit deployment decision — the pod launcher
+  // passes --host 0.0.0.0 because trainers on OTHER hosts dial the
+  // coordinator's service address (a loopback-only bind would make
+  // multi-host jobs undialable).
   if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
     fprintf(stderr, "bad --host %s (want an IPv4 address)\n", host);
     exit(1);
@@ -834,8 +984,9 @@ int make_listener(const char* host, int port) {
 
 int main(int argc, char** argv) {
   int port = 7164;
-  std::string host = "0.0.0.0";
+  std::string host = "127.0.0.1";
   std::string state_file;
+  std::string run_id;
   double task_lease = 16.0;   // ref: -task-timout-dur 16s (docker/paddle_k8s:30)
   double hb_ttl = 10.0;
   for (int i = 1; i < argc; i++) {
@@ -844,11 +995,12 @@ int main(int argc, char** argv) {
     if (a == "--port") port = atoi(next());
     else if (a == "--host") host = next();
     else if (a == "--state-file") state_file = next();
+    else if (a == "--run-id") run_id = next();
     else if (a == "--task-lease-sec") task_lease = atof(next());
     else if (a == "--heartbeat-ttl-sec") hb_ttl = atof(next());
     else if (a == "--help") {
       printf("edl-coordinator --port N [--host A] [--state-file P] "
-             "[--task-lease-sec S] [--heartbeat-ttl-sec S]\n");
+             "[--run-id ID] [--task-lease-sec S] [--heartbeat-ttl-sec S]\n");
       return 0;
     }
   }
@@ -860,7 +1012,12 @@ int main(int argc, char** argv) {
           state_file.empty() ? "" : ", state-file ", state_file.c_str());
   fflush(stderr);
 
-  Coordinator coord(task_lease, hb_ttl, state_file);
+  Coordinator coord(task_lease, hb_ttl, state_file, run_id);
+  if (!coord.state_writable()) {
+    fprintf(stderr, "edl-coordinator: --state-file %s not writable\n",
+            state_file.c_str());
+    return 1;
+  }
   std::map<int, Conn> conns;
 
   while (true) {
@@ -933,12 +1090,21 @@ int main(int argc, char** argv) {
       if (cit != conns.end()) cit->second.outbuf += line;
     }
 
+    // Durability point BEFORE the acks flush: a client that reads a
+    // mutating op's success reply can rely on the delta being fsynced.
+    // While a write is failing, replies are held (and retried next
+    // iteration) rather than acknowledging un-durable state.
+    bool durable = coord.maybe_save_state();
+    if (!durable) usleep(50 * 1000);  // fs outage: don't busy-spin on POLLOUT
+
     // Flush output buffers.
-    for (auto& [fd, c] : conns) {
-      while (!c.outbuf.empty()) {
-        ssize_t n = write(fd, c.outbuf.data(), c.outbuf.size());
-        if (n > 0) c.outbuf.erase(0, n);
-        else break;
+    if (durable) {
+      for (auto& [fd, c] : conns) {
+        while (!c.outbuf.empty()) {
+          ssize_t n = write(fd, c.outbuf.data(), c.outbuf.size());
+          if (n > 0) c.outbuf.erase(0, n);
+          else break;
+        }
       }
     }
 
@@ -947,10 +1113,6 @@ int main(int argc, char** argv) {
       close(fd);
       conns.erase(fd);
     }
-
-    // Durability point: everything this iteration mutated is on disk before
-    // we block in poll again (atomic tmp+rename; no-op when clean).
-    coord.maybe_save_state();
   }
   return 0;
 }

@@ -275,21 +275,45 @@ def _local_nonloopback_ip():
         return None
 
 
-def test_native_binds_all_interfaces_cross_interface_connect():
-    """Trainers on other hosts dial the coordinator's service address — the
-    listener must not be loopback-only (VERDICT missing #3a)."""
+def test_native_binds_all_interfaces_when_asked():
+    """The pod launcher passes host=0.0.0.0 (trainers on other hosts dial the
+    coordinator's service address) — that explicit opt-in must expose the
+    port cross-interface."""
     if not has_toolchain():
         pytest.skip("no C++ toolchain")
     from edl_tpu.coordinator.client import CoordinatorClient
 
     ip = _local_nonloopback_ip()
-    server = CoordinatorServer()
+    server = CoordinatorServer(host="0.0.0.0")
     server.start()
     try:
         assert server.client("probe").ping()
         if ip:  # connect via the machine's real interface, not loopback
             with CoordinatorClient(host=ip, port=server.port, worker="x") as c:
                 assert c.ping()
+    finally:
+        server.stop()
+
+
+def test_native_default_bind_is_loopback_only():
+    """The protocol is unauthenticated, so the DEFAULT bind must be loopback:
+    exposure beyond the host is a deployment decision the launcher makes
+    explicitly (round-2 advisor finding d)."""
+    if not has_toolchain():
+        pytest.skip("no C++ toolchain")
+    from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
+
+    ip = _local_nonloopback_ip()
+    server = CoordinatorServer()  # no host argument: the default
+    server.start()
+    try:
+        assert server.client("probe").ping()  # loopback works
+        if ip:
+            with pytest.raises(CoordinatorError):
+                with CoordinatorClient(
+                    host=ip, port=server.port, worker="x", connect_timeout=1.0
+                ) as c:
+                    c.ping()
     finally:
         server.stop()
 
@@ -319,7 +343,8 @@ def test_native_state_survives_kill_and_restart(tmp_path):
             done_tasks.append(t)
         leased_not_done = w.acquire_task()  # live lease at crash time
         w.kv_put("edl/ckpt_meta", "step=200")
-        time.sleep(0.3)  # allow the event loop's save point to run
+        # NO sleep: a mutating op's ack means the delta is already fsynced
+        # (ack-after-durability) — kill -9 the instant the reply arrives.
     finally:
         server.kill()  # hard crash: no graceful shutdown path
 
@@ -343,6 +368,143 @@ def test_native_state_survives_kill_and_restart(tmp_path):
         assert not remaining & set(done_tasks)   # completed work NOT replayed
     finally:
         server2.stop()
+
+
+def test_native_state_run_id_mismatch_discards(tmp_path):
+    """A fresh run booted over ANOTHER run's state file must not resume its
+    done-set — that would silently 'complete' the new job having trained
+    nothing (round-2 advisor finding a). Same run-id resumes; different
+    run-id discards."""
+    if not has_toolchain():
+        pytest.skip("no C++ toolchain")
+    state = str(tmp_path / "coord-state.jsonl")
+
+    server = CoordinatorServer(state_file=state, run_id="run-A")
+    server.start()
+    port = server.port
+    try:
+        w = server.client("w0")
+        w.register()
+        w.add_tasks(["s0", "s1", "s2"])
+        w.complete_task(w.acquire_task())
+    finally:
+        server.kill()
+
+    # Same run restarts (coordinator pod crash): resume, no replay of done.
+    same = CoordinatorServer(port=port, state_file=state, run_id="run-A")
+    same.start()
+    try:
+        st = same.client("w0").status()
+        assert int(st["done"]) == 1 and int(st["queued"]) == 2
+    finally:
+        same.kill()
+
+    # A DIFFERENT run reusing the workspace: old state must be discarded.
+    fresh = CoordinatorServer(port=port, state_file=state, run_id="run-B")
+    fresh.start()
+    try:
+        c = fresh.client("w0")
+        st = c.status()
+        assert int(st["done"]) == 0 and int(st["queued"]) == 0
+        # The new run's own seeding + progress works and persists under B.
+        c.add_tasks(["s0", "s1"])
+        c.register()
+        c.complete_task(c.acquire_task())
+    finally:
+        fresh.kill()
+
+    # ...and B's file now resumes as B's, not A's.
+    again = CoordinatorServer(port=port, state_file=state, run_id="run-B")
+    again.start()
+    try:
+        st = again.client("w0").status()
+        assert int(st["done"]) == 1 and int(st["queued"]) == 1
+    finally:
+        again.stop()
+
+
+def test_native_delta_log_many_mutations_and_compaction(tmp_path):
+    """The state file is a delta log, not an O(dataset) rewrite per mutation:
+    thousands of completes stay cheap, the log compacts, and a kill -9 at any
+    ack boundary restores exactly (round-2 advisor finding b)."""
+    if not has_toolchain():
+        pytest.skip("no C++ toolchain")
+    import os
+
+    state = str(tmp_path / "coord-state.jsonl")
+    server = CoordinatorServer(state_file=state)
+    server.start()
+    port = server.port
+    n_tasks, n_done, n_kv = 40, 30, 4000
+    try:
+        w = server.client("w0")
+        w.register()
+        w.add_tasks([f"t{i}" for i in range(n_tasks)])
+        for _ in range(n_done):
+            w.complete_task(w.acquire_task())
+        # kv churn on ONE hot key: appended_records_ grows past both the
+        # 1024-record floor and 2x the live-state size (live state stays ~70
+        # entries), so the compaction branch MUST fire.
+        for i in range(n_kv):
+            w.kv_put("edl/ckpt_meta", f"step={i}")
+        # Compaction fired: the log is O(live state + one compaction window
+        # of deltas), far below the ~200KB an append-only history of 4000
+        # kv_puts would occupy.
+        assert os.path.getsize(state) < 120_000
+    finally:
+        server.kill()
+
+    server2 = CoordinatorServer(port=port, state_file=state)
+    server2.start()
+    try:
+        w2 = server2.client("w0")
+        st = w2.status()
+        assert int(st["done"]) == n_done
+        assert int(st["queued"]) == n_tasks - n_done
+        assert w2.kv_get("edl/ckpt_meta") == f"step={n_kv - 1}"
+    finally:
+        server2.stop()
+
+
+def test_native_kv_del_persists(tmp_path):
+    """kv_del must survive restart as a delta (a naive append-only load would
+    resurrect deleted keys)."""
+    if not has_toolchain():
+        pytest.skip("no C++ toolchain")
+    state = str(tmp_path / "coord-state.jsonl")
+    server = CoordinatorServer(state_file=state)
+    server.start()
+    port = server.port
+    try:
+        w = server.client("w0")
+        w.kv_put("keep", "1")
+        w.kv_put("drop", "2")
+        w.kv_del("drop")
+    finally:
+        server.kill()
+    server2 = CoordinatorServer(port=port, state_file=state)
+    server2.start()
+    try:
+        w = server2.client("w0")
+        assert w.kv_get("keep") == "1"
+        assert w.kv_get("drop") is None
+    finally:
+        server2.stop()
+
+
+def test_native_unwritable_state_path_fails_fast(tmp_path):
+    """With ack-after-durability, a never-writable state log would hold every
+    reply forever — a misconfigured pod must crash loudly at boot instead of
+    running silently non-durable (round-2 advisor finding c: failed writes
+    are never silently dropped)."""
+    if not has_toolchain():
+        pytest.skip("no C++ toolchain")
+    from edl_tpu.coordinator.client import CoordinatorError
+
+    state = str(tmp_path / "no-such-dir" / "state.jsonl")  # parent missing
+    server = CoordinatorServer(state_file=state)
+    with pytest.raises(CoordinatorError, match="exited at startup"):
+        server.start()
 
 
 def test_barrier_count_mismatch_rejected(coord):

@@ -34,20 +34,29 @@ ctx = LaunchContext.from_env()
 client = wait_coordinator(ctx.coordinator_endpoint)
 client.worker = os.environ.get("WORKER_NAME") or os.environ["EDL_POD_NAME"]
 distributed_init(ctx, client, timeout=90.0, jax_port={jax_port})
+if os.environ.get("MODEL") == "ctr_small":
+    from edl_tpu.models import ctr
+    model = ctr.make_model(sparse_dim=503)
+else:
+    model = fit_a_line.MODEL
 if os.environ.get("FILE_SHARD_ROOT"):
     source = FileShardSource(root=os.environ["FILE_SHARD_ROOT"], batch_size=16)
 else:
-    source = SyntheticShardSource(fit_a_line.MODEL, batch_size=16,
+    source = SyntheticShardSource(model, batch_size=16,
                                   batches_per_shard=int(os.environ.get("BATCHES_PER_SHARD", "3")))
 worker = MultiHostWorker(
-    fit_a_line.MODEL,
+    model,
     client,
     source,
     ElasticConfig(
         checkpoint_dir=os.environ["CKPT_DIR"],
         checkpoint_interval=int(os.environ.get("CKPT_INTERVAL", "1000")),
         rescale_barrier_timeout=30.0,
-        trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+        trainer=TrainerConfig(
+            optimizer="sgd", learning_rate=0.05,
+            wire_transport=os.environ.get("WIRE") == "1",
+            wire_raw_keys=tuple(json.loads(os.environ.get("WIRE_RAW_KEYS", "[]"))),
+        ),
     ),
 )
 metrics = worker.run()
@@ -55,12 +64,13 @@ print("METRICS " + json.dumps(metrics))
 """
 
 
-def spawn_worker(name, server, ckpt_dir, jax_port, num_trainers=2):
+def spawn_worker(name, server, ckpt_dir, jax_port, num_trainers=2, extra_env=None):
     env = dict(os.environ)
     env["EDL_COORDINATOR_ENDPOINT"] = server.address
     env["EDL_NUM_TRAINERS"] = str(num_trainers)
     env["WORKER_NAME"] = name
     env["CKPT_DIR"] = ckpt_dir
+    env.update(extra_env or {})
     src = WORKER_SRC.format(repo=REPO, jax_port=jax_port)
     return subprocess.Popen(
         [sys.executable, "-c", src], env=env,
@@ -95,6 +105,52 @@ def test_two_process_lockstep_training(tmp_path):
     assert metrics[0]["steps"] == 9.0
     # queue fully drained
     assert int(st["queued"]) == 0
+
+
+def _run_two_process_ctr(tmp_path, tag, wire):
+    jax_port = free_port()
+    # Slack TTLs: the CTR first-step compile can outlast the default 10 s
+    # heartbeat on a loaded single-core CI box, which would read as a
+    # membership change and force a spurious rescale-restart.
+    with CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks([f"wt/part-{i:05d}" for i in range(4)])
+        extra = {
+            "MODEL": "ctr_small",
+            "WIRE": "1" if wire else "0",
+            # dense floats would be lossy over bf16; keeping them raw makes
+            # every encoded key (u24 sparse ids, u8 labels) EXACT, so wire
+            # and raw transports must produce bit-identical training.
+            "WIRE_RAW_KEYS": '["dense"]',
+        }
+        procs = [
+            spawn_worker(f"w{i}", server, str(tmp_path / f"ck-{tag}"), jax_port,
+                         extra_env=extra)
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=240) for p in procs]
+    metrics = []
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("METRICS ")][0]
+        metrics.append(json.loads(line[len("METRICS "):]))
+    return metrics
+
+
+def test_two_process_wire_transport_matches_raw(tmp_path):
+    """VERDICT round-3 item 3: wire transport must serve multi-process jobs.
+    The codec is negotiated once through the coordinator KV (rank 0 infers +
+    publishes, rank 1 fetches), so both processes jit the identical decode
+    program — and with exact encodings the training trajectory must match
+    the raw-transport run bit-for-bit."""
+    ensure_built()
+    raw = _run_two_process_ctr(tmp_path, "raw", wire=False)
+    wired = _run_two_process_ctr(tmp_path, "wire", wire=True)
+    # both processes in lockstep within each run
+    assert wired[0]["steps"] == wired[1]["steps"] == raw[0]["steps"] > 0
+    assert wired[0]["final_loss"] == pytest.approx(wired[1]["final_loss"], abs=0)
+    # wire transport changes the transport, not the math
+    assert wired[0]["final_loss"] == pytest.approx(raw[0]["final_loss"], abs=1e-7)
 
 
 def test_elastic_rescale_one_to_two_processes(tmp_path):
@@ -285,3 +341,41 @@ def test_padded_batches_exits_when_all_shards_unreadable(tmp_path):
     with pytest.raises(SystemExit) as ei:
         list(w._padded_batches("a", ["a", "b"], steps=2))
     assert ei.value.code == RESCALE_EXIT_CODE
+
+
+class _NoMetaSource:
+    """No batch_count attribute: forces the no-metadata lockstep path."""
+
+    def __init__(self, model, counts):
+        self.model = model
+        self.counts = counts
+
+    def read(self, shard):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        for _ in range(self.counts[shard]):
+            yield self.model.synthetic_batch(rng, 8)
+
+
+def test_zero_step_round_requeues_before_completing(tmp_path):
+    """Rank 0 observing a zero-step round (no-metadata path) must NOT complete
+    the shards on its local observation alone — another rank may hold
+    un-checkpointed updates from them (round-2 advisor finding e). First zero
+    round requeues for replay; a shard zero a second time is genuinely empty
+    and completes, so no livelock."""
+    from edl_tpu.models import fit_a_line
+
+    client = _inproc_client(["empty", "full"])
+    w = _make_worker(client, tmp_path)
+    w.source = _NoMetaSource(fit_a_line.MODEL, {"empty": 0, "full": 2})
+
+    fails = []
+    orig_fail = client.fail_task
+    client.fail_task = lambda t: (fails.append(t), orig_fail(t))[1]
+
+    metrics = w.run()
+    assert fails == ["empty"]  # requeued once, not completed blind
+    st = client.status()
+    assert int(st["done"]) == 2 and int(st["queued"]) == 0
+    assert metrics["steps"] == 2.0  # 'full' trained exactly its batches

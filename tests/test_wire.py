@@ -188,3 +188,122 @@ def test_trainer_wire_transport_keeps_model_labels_exact():
     codec = WireCodec.infer(batch, no_lossy_keys=fit_a_line.MODEL.label_keys)
     assert codec.keys["y"].encoding == "raw"
     assert codec.keys["x"].encoding == "bf16"
+
+
+# -- cross-process codec agreement (VERDICT round-3 item 3) --------------------
+
+
+def test_codec_spec_round_trip():
+    """to_spec/from_spec must rebuild the IDENTICAL codec — peers use the
+    spec to compile the same decode program."""
+    batch = {
+        "dense": np.zeros((4, 13), np.float32),
+        "sparse": np.arange(4 * 26, dtype=np.int32).reshape(4, 26),
+        "label": np.array([0, 1, 0, 1], np.int32),
+    }
+    codec = WireCodec.infer(batch, no_lossy_keys=("label",))
+    twin = WireCodec.from_spec(codec.to_spec())
+    assert {k: v.encoding for k, v in twin.keys.items()} == {
+        k: v.encoding for k, v in codec.keys.items()
+    }
+    assert {k: v.dtype for k, v in twin.keys.items()} == {
+        k: v.dtype for k, v in codec.keys.items()
+    }
+    enc = twin.encode(batch)
+    dec = {k: np.asarray(v) for k, v in twin.decode(enc).items()}
+    np.testing.assert_array_equal(dec["sparse"], batch["sparse"])
+
+
+def test_codec_apply_floor_widens_ints_only():
+    batch = {
+        "ids": np.array([1, 2, 3], np.int32),      # fits u8
+        "x": np.zeros((3,), np.float32),            # bf16
+    }
+    codec = WireCodec.infer(batch)
+    assert codec.keys["ids"].encoding == "u8"
+    floored = codec.apply_floor({"ids": "u24", "x": "raw"})
+    assert floored.keys["ids"].encoding == "u24"   # widened
+    assert floored.keys["x"].encoding == "bf16"    # floats unaffected
+    # floor narrower than inference is a no-op
+    assert codec.apply_floor({"ids": "u8"}).keys["ids"].encoding == "u8"
+
+
+def test_kv_codec_channel_publish_fetch_floor():
+    """Rank 0 publishes the (floored) codec under an epoch-scoped key; peers
+    fetch the identical spec; overflow raises the persistent floor."""
+    from edl_tpu.coordinator import InProcessCoordinator
+    from edl_tpu.runtime.wire import KVCodecChannel
+
+    coord = InProcessCoordinator()
+    c0 = coord.client("r0")
+    c1 = coord.client("r1")
+    batch = {"ids": np.array([3, 7], np.int32)}
+
+    ch0 = KVCodecChannel(c0, epoch=5)
+    ch1 = KVCodecChannel(c1, epoch=5)
+    published = ch0.publish(WireCodec.infer(batch))
+    fetched = ch1.fetch(timeout=2.0)
+    assert fetched.to_spec() == published.to_spec()
+    assert fetched.keys["ids"].encoding == "u8"
+
+    # Overflow on any rank widens the floor; the NEXT epoch's negotiation
+    # starts from it, so the overflow cannot recur.
+    ch1.raise_floor("ids", "u24")
+    ch_next = KVCodecChannel(c0, epoch=6)
+    renegotiated = ch_next.publish(WireCodec.infer(batch))
+    assert renegotiated.keys["ids"].encoding == "u24"
+    # floors only widen: a narrower late write is ignored
+    ch1.raise_floor("ids", "u8")
+    assert ch_next.floor() == {"ids": "u24"}
+
+    # epoch scoping: a stale publish (older epoch) is invisible to the new
+    # incarnation; rank-0-never-published resolves to a gang restart demand
+    from edl_tpu.runtime.wire import WireRestartRequired
+    import pytest as _pytest
+    with _pytest.raises(WireRestartRequired):
+        KVCodecChannel(c1, epoch=7).fetch(timeout=0.2)
+
+
+def test_trainer_multiproc_overflow_raises_restart(monkeypatch):
+    """In a multi-process job an overflow must NOT widen in place (peers
+    would keep the old decode-jit): it publishes the widened floor and
+    demands a gang warm-restart."""
+    from edl_tpu.coordinator import InProcessCoordinator
+    from edl_tpu.runtime.wire import KVCodecChannel, WireRestartRequired
+
+    coord = InProcessCoordinator()
+    ch = KVCodecChannel(coord.client("r0"), epoch=1)
+    model = ctr.make_model(sparse_dim=200)
+    mesh = local_mesh()
+    trainer = Trainer(model, mesh, TrainerConfig(wire_transport=True),
+                      codec_channel=ch)
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+
+    rng = np.random.default_rng(0)
+    small = model.synthetic_batch(rng, 8)
+    small["sparse"] = np.clip(small["sparse"], 0, 199).astype(np.int32)
+    # Negotiation happens on the first batch... but place_batch would also
+    # shard onto the (single-process) mesh; only exercise the encode path.
+    trainer._codec = None
+    # First batch: rank 0 infers + publishes.
+    import json as _json
+    big = dict(small)
+    big["sparse"] = small["sparse"].copy()
+    try:
+        trainer.place_batch(small)
+    except Exception:
+        pass  # sharding under fake process_count may fail; codec is set
+    assert trainer._codec is not None
+    published = coord.client("x").kv_get("edl/wire_codec")
+    assert published is not None
+    assert _json.loads(published)["epoch"] == 1
+
+    big["sparse"][0, 0] = 2 ** 30  # overflows the inferred u8
+    with pytest.raises(WireRestartRequired):
+        trainer.place_batch(big)
+    floor = _json.loads(coord.client("x").kv_get("edl/wire_floor"))
+    # One widening step per restart (u8 -> u24 -> raw): the ladder bounds
+    # renegotiation at two gang restarts per key, ever.
+    assert floor["sparse"] == "u24"
