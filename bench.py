@@ -4,29 +4,63 @@ The driver's headline metric (BASELINE.json): CTR samples/sec/chip at steady
 state. The reference publishes no absolute throughput in-tree (its story is
 cluster-utilization percentages, BASELINE.md), so ``vs_baseline`` compares
 against this framework's own recorded static-mesh figure: read from
-``BENCH_BASELINE.json`` at the repo root (written once a real-TPU number
-exists) or the ``EDL_BENCH_BASELINE`` env var; until one is recorded,
-vs_baseline is reported as 1.0 (self-relative).
+``BENCH_BASELINE.json`` at the repo root or the ``EDL_BENCH_BASELINE`` env
+var; until one is recorded, vs_baseline is reported as 1.0 (self-relative).
+
+Harness notes (round-4 hardening): the tunneled host<->device link swings
+tens of percent between identical runs, so a single window (or best-of-few)
+is noise. Each run times ``EDL_BENCH_WINDOWS`` (default 7) independent
+windows and reports the MEDIAN of the best ``EDL_BENCH_KEEP`` (default 3) —
+robust to both slow outliers (link stalls) and lucky spikes. Every window's
+throughput is included in the JSON line so regressions can be diagnosed
+from recorded artifacts instead of re-runs.
+
+Modes (``EDL_BENCH_MODE``):
+- ``synthetic`` (default) — pre-generated host batches; measures the
+  jitted-step + host->device transport path (the headline number).
+- ``file`` — batches come off real on-disk ``.npz`` shards through
+  ``FileShardSource`` with prefetch + shuffle and coordinator leases: the
+  full production data path, including file reads (VERDICT r3 weak #6).
+
+``EDL_BENCH_RECORD_BASELINE=1`` re-records BENCH_BASELINE.json from THIS
+run (forcing wire_transport off — the pre-wire static-mesh configuration)
+so the baseline denominator shares the current harness.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
 
 import json
 import os
+import statistics
 import sys
+import tempfile
 import time
+
+
+def _measure_windows(run_window, windows: int, keep: int):
+    """Time ``windows`` runs of ``run_window`` (which must block until its
+    work is device-complete); return (per-window samples/s list, median of
+    the best ``keep``)."""
+    times = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        samples = run_window()
+        elapsed = time.perf_counter() - t0
+        times.append(samples / elapsed)
+    best = sorted(times, reverse=True)[: max(1, keep)]
+    return times, statistics.median(best)
 
 
 def main() -> None:
     batch_size = int(os.environ.get("EDL_BENCH_BATCH", "8192"))
     measure_steps = int(os.environ.get("EDL_BENCH_STEPS", "20"))
-    # Repeat the measurement window and keep the best: host<->device link
-    # bandwidth fluctuates heavily on shared/tunneled transports, and the
-    # best window approximates the machine's true capability.
-    windows = int(os.environ.get("EDL_BENCH_WINDOWS", "3"))
+    windows = int(os.environ.get("EDL_BENCH_WINDOWS", "7"))
+    keep = int(os.environ.get("EDL_BENCH_KEEP", "3"))
+    mode = os.environ.get("EDL_BENCH_MODE", "synthetic")
+    record_baseline = os.environ.get("EDL_BENCH_RECORD_BASELINE") == "1"
     warmup_steps = 5
 
     import jax
@@ -45,47 +79,119 @@ def main() -> None:
         model,
         mesh,
         TrainerConfig(optimizer="adagrad", learning_rate=0.05,
-                      wire_transport=True),
+                      wire_transport=not record_baseline),
     )
     state = trainer.init_state()
 
     rng = np.random.default_rng(0)
-    # Pre-generate host batches so data synthesis is off the timed path.
-    host_batches = [model.synthetic_batch(rng, batch_size) for _ in range(4)]
 
-    for i in range(warmup_steps):
-        state, loss = trainer.train_step(state, trainer.place_batch(host_batches[i % 4]))
-    jax.block_until_ready(state.params["out"]["w"])
+    if mode == "file":
+        from edl_tpu.coordinator import InProcessCoordinator
+        from edl_tpu.runtime import (
+            FileShardSource, LeaseReader, shard_names, write_shard,
+        )
 
-    best_elapsed = float("inf")
-    for _ in range(windows):
-        t0 = time.perf_counter()
-        for i in range(measure_steps):
+        data_dir = os.environ.get("EDL_BENCH_DATA_DIR") or tempfile.mkdtemp(
+            prefix="edl-bench-"
+        )
+        rows_per_shard = measure_steps * batch_size // 4
+        n_shards = 4 * (windows + 1)  # one window's worth per 4 shards
+        shards = shard_names("bench", n_shards)
+        existing = FileShardSource(root=data_dir, batch_size=batch_size)
+        have = set(existing.list_shards())
+        for shard in shards:
+            # Per-shard (not count-based) reuse check: a dir written under a
+            # different geometry regenerates rather than silently feeding the
+            # wrong row budget; shard size changes are caught by row counts.
+            if shard not in have or existing.rows(shard) != rows_per_shard:
+                write_shard(data_dir, shard,
+                            model.synthetic_batch(rng, rows_per_shard))
+        source = FileShardSource(root=data_dir, batch_size=batch_size,
+                                 shuffle_seed=0)
+        coord = InProcessCoordinator(task_lease_sec=3600.0)
+        client = coord.client("bench")
+        client.register()
+        client.add_tasks(shards)
+        reader = iter(LeaseReader(client, source, prefetch=True))
+
+        # warmup (compiles the jit against file-shaped batches)
+        for _ in range(warmup_steps):
+            state, loss = trainer.train_step(state, trainer.place_batch(next(reader)))
+        jax.block_until_ready(loss)
+
+        def run_window():
+            nonlocal state, loss
+            n = 0
+            for _ in range(measure_steps):
+                batch = next(reader, None)
+                if batch is None:
+                    break
+                state, loss = trainer.train_step(state, trainer.place_batch(batch))
+                n += 1
+            jax.block_until_ready(loss)
+            return n * batch_size
+
+        metric = "ctr_train_samples_per_sec_per_chip_filefed"
+    else:
+        # Pre-generate host batches so data synthesis is off the timed path.
+        host_batches = [model.synthetic_batch(rng, batch_size) for _ in range(4)]
+
+        for i in range(warmup_steps):
             state, loss = trainer.train_step(
                 state, trainer.place_batch(host_batches[i % 4])
             )
         jax.block_until_ready(loss)
-        best_elapsed = min(best_elapsed, time.perf_counter() - t0)
 
-    samples_per_sec = measure_steps * batch_size / best_elapsed
+        def run_window():
+            nonlocal state, loss
+            for i in range(measure_steps):
+                state, loss = trainer.train_step(
+                    state, trainer.place_batch(host_batches[i % 4])
+                )
+            jax.block_until_ready(loss)
+            return measure_steps * batch_size
+
+        metric = "ctr_train_samples_per_sec_per_chip"
+
+    window_rates, samples_per_sec = _measure_windows(run_window, windows, keep)
     per_chip = samples_per_sec / n_chips
 
+    here = os.path.dirname(os.path.abspath(__file__))
+    baseline_file = os.path.join(here, "BENCH_BASELINE.json")
+    if record_baseline:
+        with open(baseline_file, "w") as f:
+            json.dump(
+                {
+                    "samples_per_sec_per_chip": round(per_chip, 2),
+                    "note": (
+                        "static-mesh raw-transport CTR throughput recorded "
+                        "under the round-4 harness (median of best "
+                        f"{keep}/{windows} windows, {measure_steps} steps x "
+                        f"batch {batch_size}); denominator for vs_baseline"
+                    ),
+                    "windows_samples_per_sec_per_chip": [
+                        round(t / n_chips, 2) for t in window_rates
+                    ],
+                },
+                f,
+                indent=1,
+            )
+
     baseline_per_chip = float(os.environ.get("EDL_BENCH_BASELINE", "0") or 0)
-    if baseline_per_chip <= 0:
-        baseline_file = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                     "BENCH_BASELINE.json")
-        if os.path.exists(baseline_file):
-            with open(baseline_file) as f:
-                baseline_per_chip = float(json.load(f).get("samples_per_sec_per_chip", 0))
+    if baseline_per_chip <= 0 and os.path.exists(baseline_file):
+        with open(baseline_file) as f:
+            baseline_per_chip = float(json.load(f).get("samples_per_sec_per_chip", 0))
     vs_baseline = per_chip / baseline_per_chip if baseline_per_chip > 0 else 1.0
 
     print(
         json.dumps(
             {
-                "metric": "ctr_train_samples_per_sec_per_chip",
+                "metric": metric,
                 "value": round(per_chip, 2),
                 "unit": "samples/s/chip",
                 "vs_baseline": round(vs_baseline, 4),
+                "windows": [round(t / n_chips, 2) for t in window_rates],
+                "median_of_best": keep,
             }
         )
     )

@@ -1,0 +1,69 @@
+#!/bin/sh
+# Real-cluster smoke test: install the CRD/RBAC/controller into a kind
+# cluster, submit the fit_a_line elastic job, and wait for Succeeded.
+#
+# The fake-apiserver tests (tests/test_k8s.py) validate the client against
+# OUR model of the apiserver; this script validates it against a REAL one —
+# the same role minikube played for the reference (doc/install.md:37-47).
+# It needs `kind`, `kubectl`, and `docker` on PATH and cannot run in the
+# hermetic CI image (no container runtime, no network); run it from a
+# workstation and keep doc/smoke-kind.md's transcript current.
+#
+# Usage: deploy/smoke-kind.sh [--keep]   (from the repo root)
+set -eu
+
+CLUSTER="${EDL_SMOKE_CLUSTER:-edl-tpu-smoke}"
+KEEP=0
+[ "${1:-}" = "--keep" ] && KEEP=1
+
+need() { command -v "$1" >/dev/null || { echo "missing: $1" >&2; exit 2; }; }
+need kind
+need kubectl
+need docker
+
+cleanup() {
+    [ "$KEEP" = 1 ] && { echo "keeping cluster $CLUSTER"; return; }
+    kind delete cluster --name "$CLUSTER" >/dev/null 2>&1 || true
+}
+trap cleanup EXIT
+
+echo "==> kind cluster"
+kind get clusters 2>/dev/null | grep -qx "$CLUSTER" || \
+    kind create cluster --name "$CLUSTER" --wait 120s
+
+echo "==> build + load images"
+TAG=smoke sh deploy/build.sh
+kind load docker-image "edl-tpu-controller:smoke" --name "$CLUSTER"
+kind load docker-image "edl-tpu:smoke" --name "$CLUSTER"
+
+echo "==> install CRD + RBAC + controller"
+kubectl apply -f deploy/crd.yaml
+kubectl apply -f deploy/rbac.yaml
+# pin the smoke tag and never pull (images are side-loaded)
+sed -e 's|image: edl-tpu:latest|image: edl-tpu-controller:smoke|' \
+    deploy/controller.yaml | kubectl apply -f -
+kubectl -n kube-system patch deployment edl-tpu-controller --type=json -p '[
+  {"op":"add","path":"/spec/template/spec/containers/0/imagePullPolicy","value":"Never"}
+]' >/dev/null 2>&1 || true
+kubectl -n kube-system rollout status deployment/edl-tpu-controller --timeout=180s
+
+echo "==> submit fit_a_line job"
+kubectl apply -f examples/fit_a_line/job.yaml
+
+echo "==> wait for Succeeded"
+deadline=$(( $(date +%s) + 600 ))
+while :; do
+    phase="$(kubectl get trainingjob fit-a-line \
+        -o jsonpath='{.status.phase}' 2>/dev/null || true)"
+    echo "   phase=${phase:-<none>}"
+    [ "$phase" = "Succeeded" ] && break
+    if [ "$phase" = "Failed" ] || [ "$(date +%s)" -gt "$deadline" ]; then
+        echo "SMOKE FAILED (phase=${phase:-timeout})" >&2
+        kubectl get pods -A -l edl.tpu/job-name=fit-a-line -o wide || true
+        kubectl -n kube-system logs deployment/edl-tpu-controller --tail=100 || true
+        exit 1
+    fi
+    sleep 5
+done
+
+echo "SMOKE OK: fit-a-line reached Succeeded on a real apiserver"

@@ -113,6 +113,11 @@ class ReplicaSpec:
     max_instance: int = 1
     resources: ResourceRequirements = field(default_factory=ResourceRequirements)
     env: Dict[str, str] = field(default_factory=dict)
+    #: name of a PersistentVolumeClaim to mount at ``workspace`` instead of
+    #: the default pod-lifetime emptyDir. For the coordinator role this makes
+    #: the durable state file (queue/done/KV) survive pod RESCHEDULING, not
+    #: just container crashes — the full etcd-sidecar durability story.
+    state_pvc: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "ReplicaSpec":
@@ -125,6 +130,7 @@ class ReplicaSpec:
             max_instance=int(d.get("max_instance", d.get("max-instance", 1))),
             resources=ResourceRequirements.from_dict(d.get("resources")),
             env=dict(d.get("env", {})),
+            state_pvc=d.get("state_pvc", d.get("state-pvc", "")),
         )
 
     def to_dict(self) -> dict:
@@ -136,6 +142,7 @@ class ReplicaSpec:
             "max_instance": self.max_instance,
             "resources": self.resources.to_dict(),
             "env": dict(self.env),
+            "state_pvc": self.state_pvc,
         }
 
 

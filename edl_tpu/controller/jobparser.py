@@ -49,6 +49,9 @@ class RoleWorkload:
     limits: ResourceList
     env: Dict[str, str] = field(default_factory=dict)
     labels: Dict[str, str] = field(default_factory=dict)
+    #: PVC to mount at the role's workspace (coordinator durability across
+    #: pod rescheduling); empty -> pod-lifetime emptyDir.
+    state_pvc: str = ""
 
 
 def coordinator_endpoint(job: TrainingJob) -> str:
@@ -123,6 +126,7 @@ def parse_to_coordinator(job: TrainingJob) -> RoleWorkload:
         limits=limits,
         env=make_env(job, ROLE_COORDINATOR),
         labels=role_labels(job.name, ROLE_COORDINATOR),
+        state_pvc=spec.coordinator.state_pvc,
     )
 
 

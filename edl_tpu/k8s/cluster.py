@@ -209,14 +209,21 @@ class K8sCluster:
         }
         if role == ROLE_COORDINATOR and workload:
             # Back the coordinator's state file (launch.py start_coordinator
-            # snapshots the task queue/KV there) with a pod-lifetime volume so
-            # container crashes don't lose it. Cross-pod durability needs a
-            # PVC — cluster-specific, left to the operator's storage class.
+            # keeps the task queue/done-set/KV there). With
+            # spec.coordinator.state_pvc the volume is a PersistentVolumeClaim
+            # — state survives pod RESCHEDULING, the full etcd-sidecar
+            # durability story; otherwise a pod-lifetime emptyDir still
+            # covers container crashes.
             workspace = workload.env.get("EDL_WORKSPACE")
             if workspace:
-                pod_template["spec"]["volumes"] = [
-                    {"name": "coordinator-state", "emptyDir": {}}
-                ]
+                if workload.state_pvc:
+                    volume = {
+                        "name": "coordinator-state",
+                        "persistentVolumeClaim": {"claimName": workload.state_pvc},
+                    }
+                else:
+                    volume = {"name": "coordinator-state", "emptyDir": {}}
+                pod_template["spec"]["volumes"] = [volume]
                 container["volumeMounts"] = [
                     {"name": "coordinator-state", "mountPath": workspace}
                 ]

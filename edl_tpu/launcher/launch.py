@@ -177,11 +177,17 @@ def start_coordinator(ctx: LaunchContext, block: bool = True):
     )
     server.start()
     if ctx.data_shards:
-        # Idempotent across restarts: the server dedups against its restored
-        # todo/leased/done sets, so re-seeding never replays completed shards.
+        from edl_tpu.runtime.data import pass_tasks
+
+        # Multi-pass (spec.passes; ref --num_passes, docker/paddle_k8s:205-216):
+        # every pass's visit of every shard is its own lease, seeded upfront
+        # pass-major so pass 0 drains first. Idempotent across restarts: the
+        # server dedups against its restored todo/leased/done sets, so
+        # re-seeding never replays completed visits.
         with server.client("launcher-seed") as c:
-            added = c.add_tasks(ctx.data_shards)
-        log.info("seeded %d data shards", added)
+            added = c.add_tasks(pass_tasks(ctx.data_shards, ctx.passes))
+        log.info("seeded %d shard visits (%d shards x %d passes)",
+                 added, len(ctx.data_shards), max(1, ctx.passes))
     if not block:
         return server
     try:

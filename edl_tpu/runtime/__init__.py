@@ -12,7 +12,10 @@ from edl_tpu.runtime.data import (
     FileShardSource,
     LeaseReader,
     SyntheticShardSource,
+    pass_task,
+    pass_tasks,
     shard_names,
+    split_pass,
     write_shard,
 )
 from edl_tpu.runtime.distributed import DistributedIdentity, distributed_init
@@ -39,6 +42,9 @@ __all__ = [
     "abstract_like",
     "distributed_init",
     "live_state_specs",
+    "pass_task",
+    "pass_tasks",
     "shard_names",
+    "split_pass",
     "write_shard",
 ]

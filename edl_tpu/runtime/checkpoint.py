@@ -82,6 +82,12 @@ class Checkpointer:
     def wait(self) -> None:
         self._mngr.wait_until_finished()
 
+    def saving(self) -> bool:
+        """True while an async save is still in flight (non-blocking).
+        Lets completion-lag bookkeeping commit leases the moment a save
+        lands instead of waiting for the next save to be initiated."""
+        return bool(self._mngr.is_saving_in_progress())
+
     def latest_step(self) -> Optional[int]:
         return self._mngr.latest_step()
 

@@ -27,6 +27,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Mapping, Optional
 
@@ -38,6 +39,32 @@ from edl_tpu.models.base import Model
 def shard_names(prefix: str, count: int) -> List[str]:
     """Canonical shard-id scheme: '<prefix>/part-00000'..."""
     return [f"{prefix}/part-{i:05d}" for i in range(count)]
+
+
+def pass_task(shard: str, pass_idx: int) -> str:
+    """Task id for training ``shard`` on dataset pass ``pass_idx``.
+
+    Multi-pass training (``spec.passes``; ref ``--num_passes`` wiring,
+    `docker/paddle_k8s:205-216`, default `pkg/jobparser.go:63`) enqueues every
+    pass's visit of every shard as its own lease: pass 0 keeps the bare shard
+    id (back-compat), later passes suffix ``#p<k>``. All passes seed the queue
+    UPFRONT (FIFO: pass 0 drains first) — re-seeding at pass boundaries would
+    race workers observing a momentarily empty queue as job completion.
+    """
+    return shard if pass_idx == 0 else f"{shard}#p{pass_idx}"
+
+
+def split_pass(task: str) -> tuple:
+    """(base shard id, pass index) for a task id from ``pass_task``."""
+    base, sep, suffix = task.rpartition("#p")
+    if sep and suffix.isdigit():
+        return base, int(suffix)
+    return task, 0
+
+
+def pass_tasks(shards: List[str], passes: int) -> List[str]:
+    """The full multi-pass task list, pass-major (pass 0 first)."""
+    return [pass_task(s, k) for k in range(max(1, passes)) for s in shards]
 
 
 def shard_seed(shard: str) -> int:
@@ -59,7 +86,10 @@ class SyntheticShardSource:
     batches_per_shard: int
 
     def read(self, shard: str) -> Iterator[Dict[str, np.ndarray]]:
-        rng = np.random.default_rng(_shard_seed(shard))
+        # Seed from the BASE shard id: pass 2's visit of a shard is the same
+        # dataset slice as pass 1's, not fresh data.
+        base, _ = split_pass(shard)
+        rng = np.random.default_rng(_shard_seed(base))
         for _ in range(self.batches_per_shard):
             yield self.model.synthetic_batch(rng, self.batch_size)
 
@@ -101,21 +131,39 @@ class FileShardSource:
     rank-keyed, so elastic membership changes redistribute files instead of
     orphaning them.
 
-    Replay determinism: batches are consecutive row slices of the file (tail
-    padded by wrapping to keep the batch shape static for XLA); re-reading a
-    requeued shard yields bit-identical batches.
+    ``shuffle_seed`` enables within-shard row shuffling (the reference wraps
+    its readers in `paddle.reader.shuffle` with a 100x-batch buffer,
+    `example/ctr/ctr/train.py:124-126`); the permutation derives from
+    (shard id, seed), so replaying a requeued shard remains bit-identical —
+    elastic replays never skew the sample distribution.
+
+    Replay determinism: batches are row slices of the (optionally permuted)
+    file in a fixed order; a partial tail is padded by wrapping to keep the
+    batch shape static for XLA (one jit serves the whole dataset).
     """
 
     root: str
     batch_size: int
+    #: None -> file order; int -> deterministic per-shard row permutation.
+    shuffle_seed: Optional[int] = None
 
     def path(self, shard: str) -> str:
-        return os.path.join(self.root, f"{shard}.npz")
+        # Pass suffixes address a VISIT of a shard, not a different file.
+        base, _ = split_pass(shard)
+        return os.path.join(self.root, f"{base}.npz")
 
     def read(self, shard: str) -> Iterator[Dict[str, np.ndarray]]:
         with np.load(self.path(shard)) as data:
             arrays = {k: data[k] for k in data.files}
         rows = next(iter(arrays.values())).shape[0] if arrays else 0
+        if self.shuffle_seed is not None and rows > 1:
+            # Seed from the FULL task id: each pass re-visits the same rows
+            # in a fresh (but replay-deterministic) order.
+            rng = np.random.default_rng(
+                (_shard_seed(shard) ^ self.shuffle_seed) & 0xFFFFFFFFFFFFFFFF
+            )
+            perm = rng.permutation(rows)
+            arrays = {k: a[perm] for k, a in arrays.items()}
         for start in range(0, rows, self.batch_size):
             idx = np.arange(start, start + self.batch_size)
             # wrap the tail: static batch shape, no rows dropped
@@ -159,6 +207,24 @@ class LeaseReader:
     ``stop_check`` is polled between batches — the elastic worker passes its
     epoch-change detector so a rescale interrupts mid-shard, failing the lease
     back to the queue for replay on the new mesh.
+
+    ``defer_completion=True`` turns immediate completion into **completion
+    lag**: a fully-read shard moves to ``consumed`` with its lease still held,
+    and the caller completes it only once a durable checkpoint covers its
+    updates (``take_consumed`` -> ``client.complete_task``). A hard crash
+    (kill -9) between checkpoints therefore replays exactly the shards whose
+    updates the restored checkpoint lacks — true at-least-once, the guarantee
+    the reference gets from pserver-held state + master lease requeue
+    (`docker/paddle_k8s:26-32`). Immediate completion is at-MOST-once across
+    crashes: a completed-but-uncovered shard would be lost forever.
+
+    ``prefetch=True`` pipelines the data path: the NEXT shard's read happens
+    on a background thread while the current shard's batches feed training,
+    so the accelerator never stalls on a shard load (the reference
+    double-buffers host feeding the same way: `py_reader.start()`,
+    `example/ctr/ctr/train.py:120-129,158`). Costs one extra held lease and
+    up to two shards of host RAM; all coordinator RPCs stay on the calling
+    thread (the client connection is not thread-safe).
     """
 
     def __init__(
@@ -166,21 +232,51 @@ class LeaseReader:
         client,  # CoordinatorClient | InProcessClient
         source,  # object with .read(shard) -> Iterator[batch]
         stop_check: Optional[Callable[[], bool]] = None,
+        defer_completion: bool = False,
+        prefetch: bool = False,
     ):
         self.client = client
         self.source = source
         self.stop_check = stop_check or (lambda: False)
+        self.defer_completion = defer_completion
+        self.prefetch = prefetch
         self.completed: List[str] = []
+        #: defer mode: fully-read shards whose leases are still held, awaiting
+        #: a covering checkpoint.
+        self.consumed: List[str] = []
+        #: the task whose batches are currently being yielded (per-pass
+        #: metrics attribution; see ``split_pass``).
+        self.current: Optional[str] = None
         self.interrupted: Optional[str] = None
         self.exhausted = False
 
+    def take_consumed(self) -> List[str]:
+        """Drain the consumed-but-uncompleted list (defer mode). The caller
+        completes these AFTER the checkpoint covering them is durable."""
+        out, self.consumed = self.consumed, []
+        return out
+
+    def _finish(self, task: str) -> None:
+        if self.defer_completion:
+            self.consumed.append(task)
+        else:
+            self.client.complete_task(task)
+            self.completed.append(task)
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self.prefetch:
+            yield from self._iter_prefetch()
+        else:
+            yield from self._iter_sync()
+
+    def _iter_sync(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:
             reply = self.client.acquire()
             task = reply.get("task")
             if task is None:
                 self.exhausted = bool(reply.get("exhausted"))
                 return
+            self.current = task
             for batch in self.source.read(task):
                 if self.stop_check():
                     # Rescale signal mid-shard: give the lease back for a
@@ -189,5 +285,53 @@ class LeaseReader:
                     self.interrupted = task
                     return
                 yield batch
-            self.client.complete_task(task)
-            self.completed.append(task)
+            self._finish(task)
+
+    def _iter_prefetch(self) -> Iterator[Dict[str, np.ndarray]]:
+        ex = ThreadPoolExecutor(1, thread_name_prefix="edl-prefetch")
+        try:
+            yield from self._prefetch_loop(ex)
+        finally:
+            # No wait: on a rescale interrupt the in-flight prefetched load
+            # is garbage (its lease already failed back) — blocking recovery
+            # on a full shard read would bill dead work to the <30 s budget.
+            ex.shutdown(wait=False, cancel_futures=True)
+
+    def _prefetch_loop(self, ex: ThreadPoolExecutor) -> Iterator[Dict[str, np.ndarray]]:
+        def load(shard: str) -> Future:
+            # Materializing the shard bounds RAM at <= 2 shards and keeps the
+            # loader thread free of client RPCs.
+            return ex.submit(lambda s=shard: list(self.source.read(s)))
+
+        reply = self.client.acquire()
+        cur = reply.get("task")
+        if cur is None:
+            self.exhausted = bool(reply.get("exhausted"))
+            return
+        fut = load(cur)
+        while cur is not None:
+            nxt = self.client.acquire().get("task")  # overlaps cur's training
+            nfut = load(nxt) if nxt is not None else None
+            self.current = cur
+            for batch in fut.result():
+                if self.stop_check():
+                    self.client.fail_task(cur)
+                    if nxt is not None:
+                        if nfut is not None:
+                            nfut.cancel()
+                        self.client.fail_task(nxt)
+                    self.interrupted = cur
+                    return
+                yield batch
+            self._finish(cur)
+            cur, fut = nxt, nfut
+        # The pipeline's look-ahead acquire saw an empty queue one shard ago;
+        # re-check now that the final shard completed. A task appearing here
+        # (late requeue) goes back to the queue — the caller's outer loop
+        # re-enters a reader for it.
+        final = self.client.acquire()
+        if final.get("task") is not None:
+            self.client.fail_task(final["task"])
+            self.exhausted = False
+        else:
+            self.exhausted = bool(final.get("exhausted"))

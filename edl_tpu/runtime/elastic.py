@@ -35,7 +35,7 @@ from jax.sharding import Mesh
 from edl_tpu.models.base import Model
 from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
-from edl_tpu.runtime.data import LeaseReader
+from edl_tpu.runtime.data import LeaseReader, split_pass
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
 
 log = logging.getLogger("edl_tpu.elastic")
@@ -58,6 +58,10 @@ class ElasticConfig:
     #: distributed_init and restores from the checkpoint. Single-host jobs
     #: (the default) re-slice local devices without restarting.
     restart_on_rescale: bool = False
+    #: pipeline the data path: the next shard loads on a background thread
+    #: while the current shard's batches feed training (costs one extra held
+    #: lease + up to two shards of host RAM). See LeaseReader.
+    prefetch: bool = False
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
 
@@ -112,6 +116,15 @@ class ElasticWorker:
         self._world = 0
         self._prev_world = 0
         self._last_heartbeat = 0.0
+        #: completion lag (at-least-once across hard crashes): shards whose
+        #: updates the save initiated LAST is covering — their leases are
+        #: completed once the NEXT save initiation proves that save durable
+        #: (orbax serializes async saves).
+        self._pending_commit: List[str] = []
+        #: fully-consumed shards no initiated save covers yet.
+        self._carry_consumed: List[str] = []
+        #: per-pass step counts (multi-pass training; key = pass index).
+        self.pass_steps: Dict[int, int] = {}
 
     # -- membership ------------------------------------------------------------
 
@@ -189,6 +202,32 @@ class ElasticWorker:
         if block:
             self.ckpt.wait()
 
+    def _checkpoint_and_commit(
+        self, state: TrainState, reader: Optional[LeaseReader], block: bool
+    ) -> None:
+        """Save, then complete every shard lease a DURABLE save now covers.
+
+        Async path: ``ckpt.save`` blocks until the previous async save
+        finished, so entering it proves the prior save (covering
+        ``_pending_commit``) is durable — those complete now, and the shards
+        consumed since become the new in-flight pending set. Blocking path:
+        everything consumed so far is durable; complete it all. A kill -9
+        at ANY point replays exactly the shards no durable save covers.
+        """
+        consumed = self._carry_consumed + (
+            reader.take_consumed() if reader is not None else []
+        )
+        self._carry_consumed = []
+        self._checkpoint(state, block=block)
+        covered = self._pending_commit
+        if block:
+            covered = covered + consumed
+            self._pending_commit = []
+        else:
+            self._pending_commit = consumed
+        for task in covered:
+            self.client.complete_task(task)
+
     # -- main loop -------------------------------------------------------------
 
     def run(self, max_rescales: int = 32) -> Dict[str, float]:
@@ -225,7 +264,11 @@ class ElasticWorker:
 
             while not rescale and not finished:
                 reader = LeaseReader(
-                    self.client, self.source, stop_check=self._epoch_changed
+                    self.client,
+                    self.source,
+                    stop_check=self._epoch_changed,
+                    defer_completion=True,
+                    prefetch=self.config.prefetch,
                 )
                 if self.profiler is not None:
                     self.profiler.start()
@@ -248,19 +291,35 @@ class ElasticWorker:
                             )
                     self.steps_done += 1
                     self.losses.append(float(loss))
+                    if reader.current is not None:
+                        p = split_pass(reader.current)[1]
+                        self.pass_steps[p] = self.pass_steps.get(p, 0) + 1
                     step = int(state.step)
                     if step - last_ckpt_step >= self.config.checkpoint_interval:
-                        self._checkpoint(state)
+                        self._checkpoint_and_commit(state, reader, block=False)
                         last_ckpt_step = step
+                    elif self._pending_commit and not self.ckpt.saving():
+                        # The in-flight save landed: its shards are durable
+                        # now — complete them immediately rather than holding
+                        # leases until the next save initiation (which could
+                        # cross the lease TTL and force a pointless replay).
+                        for task in self._pending_commit:
+                            self.client.complete_task(task)
+                        self._pending_commit = []
 
+                self._carry_consumed.extend(reader.take_consumed())
                 if reader.interrupted is not None:
                     rescale = True
                 elif reader.exhausted:
                     finished = True
                 else:
-                    # Queue empty but leases outstanding elsewhere: a peer may
-                    # still fail and requeue its shard, so keep polling until
-                    # the queue truly drains (or membership changes).
+                    # Queue empty but leases outstanding. Some may be OUR OWN
+                    # completion-lagged shards: flush them durably so the
+                    # queue can actually drain (multihost's tail-flush rule),
+                    # then keep polling — a peer may still fail and requeue.
+                    if self._carry_consumed or self._pending_commit:
+                        self._checkpoint_and_commit(state, None, block=True)
+                        last_ckpt_step = int(state.step)
                     time.sleep(0.2)
                     if self._epoch_changed(force=True):
                         rescale = True
@@ -268,7 +327,7 @@ class ElasticWorker:
             if rescale:
                 # Membership changed: make state durable, then rendezvous at
                 # the top of the loop and rebuild at the agreed world size.
-                self._checkpoint(state, block=True)
+                self._checkpoint_and_commit(state, None, block=True)
                 if self.config.restart_on_rescale:
                     from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
 
@@ -285,18 +344,21 @@ class ElasticWorker:
                     raise RuntimeError("too many rescales; aborting")
                 continue
 
-            # Queue exhausted: final checkpoint and finish.
-            self._checkpoint(state, block=True)
+            # Queue exhausted: final checkpoint, commit held leases, finish.
+            self._checkpoint_and_commit(state, None, block=True)
             total = time.perf_counter() - t_start
             if self.profiler is not None:
                 prof = {f"profile_{k}": v for k, v in self.profiler.summary().items()}
             else:
                 prof = {}
+            if self.pass_steps:
+                log.info("per-pass steps: %s", dict(sorted(self.pass_steps.items())))
             return {
                 **prof,
                 "steps": float(self.steps_done),
                 "final_loss": self.losses[-1] if self.losses else float("nan"),
                 "world": float(self._world),
+                "passes_trained": float(len(self.pass_steps)),
                 "rescales": float(len(self.rescales)),
                 "max_recovery_seconds": max(
                     (r.recovery_seconds for r in self.rescales), default=0.0

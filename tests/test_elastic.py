@@ -6,6 +6,7 @@ rebuilds an 8-device mesh, restores (orbax reshards row-sharded tables on
 load), and resumes from the leased shard queue with deterministic replay.
 """
 
+import json
 import threading
 import time
 
@@ -164,3 +165,182 @@ def test_elastic_worker_rescales_4_to_8(tmp_path):
     assert st["done"] == 6 and st["queued"] == 0 and st["leased"] == 0
     # the model actually learned through the rescale
     assert metrics["final_loss"] < 0.1, metrics
+
+
+# -- completion lag: at-least-once across hard crashes (VERDICT r3 item 5) -----
+
+
+def test_lease_reader_defer_completion_holds_leases():
+    """defer_completion moves fully-read shards to `consumed` with leases
+    still held; completion happens only when the caller commits them after a
+    covering checkpoint."""
+    coord = InProcessCoordinator(task_lease_sec=30.0)
+    c = coord.client("r1")
+    c.register()
+    c.add_tasks(shard_names("lag", 2))
+    source = SyntheticShardSource(fit_a_line.MODEL, batch_size=8, batches_per_shard=2)
+
+    reader = LeaseReader(c, source, defer_completion=True)
+    batches = list(reader)
+    assert len(batches) == 4
+    st = c.status()
+    # nothing completed yet: a crash here must replay BOTH shards
+    assert st["done"] == 0 and st["leased"] == 2
+    held = reader.take_consumed()
+    assert set(held) == set(shard_names("lag", 2))
+    assert reader.take_consumed() == []  # drained
+    for t in held:  # "checkpoint covered them" -> commit
+        c.complete_task(t)
+    st = c.status()
+    assert st["done"] == 2 and st["leased"] == 0
+    # queue drains only after the held leases commit
+    reader2 = LeaseReader(c, source, defer_completion=True)
+    assert list(reader2) == [] and reader2.exhausted
+
+
+def test_lease_reader_prefetch_matches_sync():
+    """The prefetch pipeline must yield exactly the sync reader's batches
+    (same shards, same order, bit-identical data) while loading the next
+    shard off-thread."""
+    coord = InProcessCoordinator(task_lease_sec=30.0)
+    model = fit_a_line.MODEL
+    source = SyntheticShardSource(model, batch_size=8, batches_per_shard=3)
+
+    c1 = coord.client("sync")
+    c1.register()
+    c1.add_tasks(shard_names("pf", 3))
+    sync_batches = [b["x"].copy() for b in LeaseReader(c1, source)]
+
+    coord2 = InProcessCoordinator(task_lease_sec=30.0)
+    c2 = coord2.client("pre")
+    c2.register()
+    c2.add_tasks(shard_names("pf", 3))
+    reader = LeaseReader(c2, source, prefetch=True)
+    pre_batches = [b["x"].copy() for b in reader]
+    assert reader.exhausted
+    assert set(reader.completed) == set(shard_names("pf", 3))
+    assert len(pre_batches) == len(sync_batches) == 9
+    for a, b in zip(sync_batches, pre_batches):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_lease_reader_prefetch_interrupt_fails_both_leases():
+    """A rescale mid-shard under prefetch must fail BOTH held leases (current
+    and prefetched) back to the queue — no lease may leak to expiry."""
+    coord = InProcessCoordinator(task_lease_sec=30.0)
+    c = coord.client("r")
+    c.register()
+    c.add_tasks(shard_names("int", 3))
+    source = SyntheticShardSource(fit_a_line.MODEL, batch_size=8, batches_per_shard=3)
+    count = [0]
+    reader = LeaseReader(c, source, prefetch=True,
+                         stop_check=lambda: count[0] >= 2)
+    got = []
+    for b in reader:
+        got.append(b)
+        count[0] += 1
+    assert reader.interrupted is not None
+    st = c.status()
+    assert st["leased"] == 0, st  # both leases handed back immediately
+    assert st["queued"] + st["done"] == 3
+
+
+WORKER_CRASH_SRC = """
+import json, os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import time
+
+from edl_tpu.coordinator.client import CoordinatorClient
+from edl_tpu.models import fit_a_line
+from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
+from edl_tpu.runtime.train_loop import TrainerConfig
+
+
+class SlowSource(SyntheticShardSource):
+    def read(self, shard):
+        for b in super().read(shard):
+            time.sleep(0.05)  # give the parent a window to SIGKILL mid-run
+            yield b
+
+
+client = CoordinatorClient(port=int(os.environ["PORT"]), worker=os.environ["NAME"])
+source = SlowSource(fit_a_line.MODEL, batch_size=8, batches_per_shard=6)
+cfg = ElasticConfig(
+    checkpoint_dir=os.environ["CKPT"],
+    checkpoint_interval=6,          # ~one shard per checkpoint
+    heartbeat_interval=0.0,
+    trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+)
+worker = ElasticWorker(fit_a_line.MODEL, client, source, cfg,
+                       device_planner=lambda w: jax.devices())
+metrics = worker.run()
+print("METRICS " + json.dumps(metrics))
+"""
+
+
+def test_kill9_replays_exactly_uncommitted_shards(tmp_path):
+    """Hard-crash a single-host elastic worker mid-run (SIGKILL — no cleanup
+    path) and restart: completed shards are NOT retrained (their covering
+    checkpoint restored) and every non-completed shard replays. This is the
+    at-least-once guarantee immediate completion lacked (VERDICT r3 item 5;
+    ref model: the master re-leases timed-out tasks, docker/paddle_k8s:30).
+    """
+    import os
+    import subprocess
+    import sys
+
+    from edl_tpu.coordinator import CoordinatorServer
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    n_shards, batches_per_shard = 6, 6
+    with CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks(shard_names("crash", n_shards))
+
+        def spawn(name):
+            env = dict(os.environ)
+            env.update(PORT=str(server.port), NAME=name,
+                       CKPT=str(tmp_path / "ck"))
+            return subprocess.Popen(
+                [sys.executable, "-c", WORKER_CRASH_SRC.format(repo=repo)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+
+        p1 = spawn("w0")
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if int(admin.status().get("done", 0)) >= 2:
+                break
+            if p1.poll() is not None:
+                out, err = p1.communicate()
+                pytest.fail(f"worker finished before kill:\n{err[-2000:]}")
+            time.sleep(0.02)
+        else:
+            pytest.fail("worker never committed 2 shards")
+        p1.kill()  # SIGKILL: no atexit, no finally, leases left dangling
+        p1.wait()
+
+        done_at_kill = int(admin.status()["done"])
+        # the dead worker's leases requeue (here: explicit leave in lieu of
+        # waiting out the heartbeat TTL)
+        server.client("w0").leave()
+
+        p2 = spawn("w1")
+        out, err = p2.communicate(timeout=240)
+        assert p2.returncode == 0, f"restarted worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("METRICS ")][0]
+        metrics = json.loads(line[len("METRICS "):])
+
+        st = admin.status()
+    assert int(st["done"]) == n_shards and int(st["queued"]) == 0
+    # Replay EXACTLY the shards no completion covered: each non-done shard
+    # contributes its full batch count to the restarted worker, no more.
+    expected_replay_steps = (n_shards - done_at_kill) * batches_per_shard
+    assert metrics["steps"] == float(expected_replay_steps), (
+        metrics, done_at_kill,
+    )

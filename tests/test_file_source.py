@@ -192,3 +192,40 @@ sys.exit(start_trainer(ctx))
     assert all(m["world"] == 3.0 for m in finals), finals
     assert int(st["queued"]) == 0 and int(st["leased"]) == 0
     assert int(st["done"]) == len(rows)
+
+
+def test_shuffle_is_deterministic_and_row_preserving(tmp_path):
+    """Within-shard shuffling (ref: paddle.reader.shuffle with a 100x-batch
+    buffer, example/ctr/ctr/train.py:124-126) must keep replays bit-identical
+    — the permutation derives from (shard id, seed) — while actually
+    reordering rows and dropping none."""
+    root = str(tmp_path)
+    rng = np.random.default_rng(7)
+    arrays = {"x": rng.standard_normal((40, 3)).astype(np.float32),
+              "y": np.arange(40, dtype=np.int32)}
+    write_shard(root, "sh/part-00000", arrays)
+    write_shard(root, "sh/part-00001",
+                {"x": arrays["x"] + 1.0, "y": arrays["y"] + 100})
+
+    plain = FileShardSource(root=root, batch_size=8)
+    shuf = FileShardSource(root=root, batch_size=8, shuffle_seed=3)
+
+    a = np.concatenate([b["y"] for b in shuf.read("sh/part-00000")])
+    b = np.concatenate([b["y"] for b in shuf.read("sh/part-00000")])
+    np.testing.assert_array_equal(a, b)  # replay: bit-identical
+    order = np.concatenate([b["y"] for b in plain.read("sh/part-00000")])
+    assert not np.array_equal(a, order)  # actually shuffled
+    assert set(a.tolist()) == set(range(40))  # no rows dropped or duplicated
+
+    # different shards (and different seeds) get different permutations
+    other = np.concatenate([b["y"] for b in shuf.read("sh/part-00001")]) - 100
+    assert not np.array_equal(a, other)
+    shuf2 = FileShardSource(root=root, batch_size=8, shuffle_seed=4)
+    c = np.concatenate([b["y"] for b in shuf2.read("sh/part-00000")])
+    assert not np.array_equal(a, c)
+
+    # rows stay aligned across keys under the permutation
+    for batch in shuf.read("sh/part-00000"):
+        np.testing.assert_array_equal(
+            batch["x"], arrays["x"][batch["y"]]
+        )
