@@ -48,7 +48,10 @@ kubectl -n kube-system patch deployment edl-tpu-controller --type=json -p '[
 kubectl -n kube-system rollout status deployment/edl-tpu-controller --timeout=180s
 
 echo "==> submit fit_a_line job"
-kubectl apply -f examples/fit_a_line/job.yaml
+# retag to the side-loaded image: :smoke defaults to IfNotPresent, so the
+# kind node uses the loaded image instead of pulling (which would fail)
+sed 's|image: edl-tpu:latest|image: edl-tpu:smoke|' \
+    examples/fit_a_line/job.yaml | kubectl apply -f -
 
 echo "==> wait for Succeeded"
 deadline=$(( $(date +%s) + 600 ))

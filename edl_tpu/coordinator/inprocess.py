@@ -93,7 +93,18 @@ class InProcessCoordinator:
                 self._release_sync()
             else:
                 self._members[worker]["last_heartbeat"] = time.monotonic()
+                self._renew_leases(worker)
             return self._membership_reply(worker)
+
+    def _renew_leases(self, worker: str) -> None:
+        """A live worker keeps its leases (etcd-keepalive semantics): renewal
+        rides heartbeats, so completion-lag holds can outlive task_lease_sec
+        without healthy runs retraining shards; expiry fires only when the
+        heartbeat ALSO stopped — a real failure. Mirrors the C++ service."""
+        deadline = time.monotonic() + self.task_lease_sec
+        for lease in self._leased.values():
+            if lease["worker"] == worker:
+                lease["deadline"] = deadline
 
     def heartbeat(self, worker: str) -> Dict:
         with self._lock:
@@ -101,6 +112,7 @@ class InProcessCoordinator:
             if worker not in self._members:
                 return {"ok": False, "error": "unknown worker", "epoch": self._epoch}
             self._members[worker]["last_heartbeat"] = time.monotonic()
+            self._renew_leases(worker)
             return self._membership_reply(worker)
 
     def leave(self, worker: str) -> Dict:
@@ -209,6 +221,7 @@ class InProcessCoordinator:
                 return {"ok": False, "error": "unknown worker",
                         "epoch": self._epoch, "world": len(self._members)}
             self._members[worker]["last_heartbeat"] = time.monotonic()
+            self._renew_leases(worker)
             if epoch != self._epoch:
                 return {"ok": False, "resync": True,
                         "epoch": self._epoch, "world": len(self._members)}

@@ -37,6 +37,7 @@ from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.data import LeaseReader, split_pass
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
+from edl_tpu.runtime.wire import WireRestartRequired
 
 log = logging.getLogger("edl_tpu.elastic")
 
@@ -272,40 +273,57 @@ class ElasticWorker:
                 )
                 if self.profiler is not None:
                     self.profiler.start()
-                for batch in reader:
-                    placed = trainer.place_batch(batch)
-                    state, loss = trainer.train_step(state, placed)
-                    if self.profiler is not None:
-                        self.profiler.step(len(next(iter(batch.values()))))
-                    if not first_step_done:
-                        first_step_done = True
-                        recovery = time.perf_counter() - rescale_t0
-                        if self.steps_done:  # a rescale, not cold start
-                            self.rescales.append(
-                                RescaleEvent(
-                                    at_step=int(state.step),
-                                    from_world=self._prev_world,
-                                    to_world=world,
-                                    recovery_seconds=recovery,
+                try:
+                    for batch in reader:
+                        placed = trainer.place_batch(batch)
+                        state, loss = trainer.train_step(state, placed)
+                        if self.profiler is not None:
+                            self.profiler.step(len(next(iter(batch.values()))))
+                        if not first_step_done:
+                            first_step_done = True
+                            recovery = time.perf_counter() - rescale_t0
+                            if self.steps_done:  # a rescale, not cold start
+                                self.rescales.append(
+                                    RescaleEvent(
+                                        at_step=int(state.step),
+                                        from_world=self._prev_world,
+                                        to_world=world,
+                                        recovery_seconds=recovery,
+                                    )
                                 )
-                            )
-                    self.steps_done += 1
-                    self.losses.append(float(loss))
-                    if reader.current is not None:
-                        p = split_pass(reader.current)[1]
-                        self.pass_steps[p] = self.pass_steps.get(p, 0) + 1
-                    step = int(state.step)
-                    if step - last_ckpt_step >= self.config.checkpoint_interval:
-                        self._checkpoint_and_commit(state, reader, block=False)
-                        last_ckpt_step = step
-                    elif self._pending_commit and not self.ckpt.saving():
-                        # The in-flight save landed: its shards are durable
-                        # now — complete them immediately rather than holding
-                        # leases until the next save initiation (which could
-                        # cross the lease TTL and force a pointless replay).
-                        for task in self._pending_commit:
-                            self.client.complete_task(task)
-                        self._pending_commit = []
+                        self.steps_done += 1
+                        self.losses.append(float(loss))
+                        if reader.current is not None:
+                            p = split_pass(reader.current)[1]
+                            self.pass_steps[p] = self.pass_steps.get(p, 0) + 1
+                        step = int(state.step)
+                        if step - last_ckpt_step >= self.config.checkpoint_interval:
+                            self._checkpoint_and_commit(state, reader, block=False)
+                            last_ckpt_step = step
+                        elif self._pending_commit and not self.ckpt.saving():
+                            # The in-flight save landed: its shards are
+                            # durable now — complete them immediately rather
+                            # than holding leases until the next save
+                            # initiation.
+                            for task in self._pending_commit:
+                                self.client.complete_task(task)
+                            self._pending_commit = []
+                except WireRestartRequired as e:
+                    # Multi-process wire-codec overflow (only raised when
+                    # jax.process_count() > 1): the widened floor is already
+                    # published, and renegotiation needs a fresh membership
+                    # epoch — which an in-process rebuild cannot produce (the
+                    # jax.distributed world is fixed at initialize). Flush
+                    # durable state and take the gang warm-restart exit, the
+                    # same path a rescale takes, regardless of
+                    # restart_on_rescale.
+                    from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
+
+                    self._carry_consumed.extend(reader.take_consumed())
+                    self._checkpoint_and_commit(state, None, block=True)
+                    log.warning("wire codec overflow (%s); exiting %d for "
+                                "gang warm-restart", e, RESCALE_EXIT_CODE)
+                    raise SystemExit(RESCALE_EXIT_CODE)
 
                 self._carry_consumed.extend(reader.take_consumed())
                 if reader.interrupted is not None:

@@ -394,6 +394,16 @@ class Coordinator {
   // Release all parked sync waiters: ok=true when the epoch rendezvous
   // completed, ok=false (resync) when membership moved underneath them.
   void release_sync(bool ok);
+  // A live worker keeps its leases: heartbeats (and sync arrivals) extend
+  // its lease deadlines like etcd keepalives, so completion-lag holds
+  // (shards completed only after a covering checkpoint) can outlive the
+  // lease TTL without healthy runs retraining shards. Expiry then fires
+  // only for workers whose HEARTBEAT also stopped — real failures.
+  void renew_leases(const std::string& worker) {
+    double deadline = now_sec() + task_lease_sec_;
+    for (auto& [_, lease] : leased_)
+      if (lease.worker == worker) lease.deadline = deadline;
+  }
   void drop_member(const std::string& name);
   void requeue_expired_leases(double now);
   std::string membership_reply(const std::string& worker, bool ok_rank);
@@ -698,6 +708,7 @@ std::string Coordinator::op_register(const JsonObject& req) {
     release_sync(false);
   } else {
     it->second.last_heartbeat = now_sec();  // re-register == refresh
+    renew_leases(worker);
   }
   return membership_reply(worker, true);
 }
@@ -709,6 +720,7 @@ std::string Coordinator::op_heartbeat(const JsonObject& req) {
     return JsonWriter().field("ok", false).field("error", "unknown worker")
         .field("epoch", (double)epoch_).done();
   it->second.last_heartbeat = now_sec();
+  renew_leases(worker);
   return membership_reply(worker, true);
 }
 
@@ -830,6 +842,7 @@ std::string Coordinator::op_sync(const JsonObject& req, int fd) {
     return JsonWriter().field("ok", false).field("error", "unknown worker")
         .field("epoch", (double)epoch_).field("world", (double)members_.size()).done();
   it->second.last_heartbeat = now_sec();  // arrival refreshes the TTL
+  renew_leases(worker);
   if (epoch != epoch_)
     return JsonWriter().field("ok", false).field("resync", true)
         .field("epoch", (double)epoch_).field("world", (double)members_.size()).done();

@@ -532,3 +532,23 @@ def test_barrier_count_mismatch_rejected(coord):
     ta.join(timeout=10)
     assert ok.get("ok") is True
     assert results["a"].get("ok") is True
+
+
+def test_heartbeat_renews_leases(coord):
+    """A LIVE worker keeps its leases (etcd-keepalive semantics): heartbeats
+    extend lease deadlines, so completion-lag holds — shards completed only
+    after a covering checkpoint — can outlive task_lease_sec without healthy
+    runs retraining shards. Expiry fires only when the heartbeat also stops
+    (covered by test_lease_requeue_on_expiry)."""
+    a = coord.client("alive")
+    a.register()
+    a.add_tasks(["renew0"])
+    assert a.acquire_task() == "renew0"
+    # fixture lease TTL is 1.0 s: hold the lease across 2.4 s of heartbeats
+    for _ in range(6):
+        time.sleep(0.4)
+        a.heartbeat()
+    st = a.status()
+    assert int(st["leased"]) == 1 and int(st["queued"]) == 0, st
+    assert a.complete_task("renew0").get("ok") is True
+    a.leave()

@@ -344,3 +344,43 @@ def test_kill9_replays_exactly_uncommitted_shards(tmp_path):
     assert metrics["steps"] == float(expected_replay_steps), (
         metrics, done_at_kill,
     )
+
+
+def test_elastic_worker_wire_overflow_exits_for_warm_restart(tmp_path, monkeypatch):
+    """A WireRestartRequired surfacing mid-run (multi-process codec overflow)
+    must take the gang warm-restart exit (RESCALE_EXIT_CODE) after flushing
+    durable state — not crash with a generic failure that burns the job's
+    failure budget."""
+    from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
+    from edl_tpu.runtime import SyntheticShardSource
+    from edl_tpu.runtime.wire import WireRestartRequired
+
+    coord = InProcessCoordinator(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    client = coord.client("w0")
+    client.register()
+    client.add_tasks(shard_names("ov", 2))
+    model = fit_a_line.MODEL
+    worker = ElasticWorker(
+        model, client,
+        SyntheticShardSource(model, batch_size=8, batches_per_shard=2),
+        ElasticConfig(checkpoint_dir=str(tmp_path / "ck"),
+                      trainer=TrainerConfig(optimizer="sgd")),
+        device_planner=lambda w: jax.devices(),
+    )
+
+    orig = Trainer.place_batch
+    calls = [0]
+
+    def overflow_on_third(self, batch):
+        calls[0] += 1
+        if calls[0] == 3:  # mid-second-shard: consumed + in-flight state
+            raise WireRestartRequired("sparse")
+        return orig(self, batch)
+
+    monkeypatch.setattr(Trainer, "place_batch", overflow_on_third)
+    with pytest.raises(SystemExit) as ei:
+        worker.run()
+    assert ei.value.code == RESCALE_EXIT_CODE
+    # durable flush happened: the fully-consumed first shard committed
+    st = client.status()
+    assert int(st["done"]) == 1, st
