@@ -201,6 +201,59 @@ class FileShardSource:
         return sorted(out)
 
 
+def prefetch_iter(it: Iterator, depth: int = 2) -> Iterator:
+    """Run ``it`` on a background thread, staying ``depth`` items ahead.
+
+    Batch-level read-ahead for iterators whose production cost (file
+    decompression, array slicing) should overlap the consumer's device
+    compute — the lockstep multihost path uses this (its shard-level
+    pipeline lives in ``LeaseReader`` and needs lease RPCs the lockstep
+    protocol routes differently). Exceptions — including SystemExit from
+    a source that demands a gang restart — re-raise in the CONSUMER, not
+    the pump thread, so control flow is identical to plain iteration.
+    """
+    import queue as _queue
+    import threading as _threading
+
+    q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
+    stop = _threading.Event()
+
+    def put(msg) -> bool:
+        # Timeout-put so an abandoned consumer (early break / exception in
+        # the training loop) cannot leave the pump parked in q.put forever,
+        # pinning the source iterator and buffered batches.
+        while not stop.is_set():
+            try:
+                q.put(msg, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def pump():
+        try:
+            for item in it:
+                if not put(("item", item)):
+                    return
+            put(("end", None))
+        except BaseException as e:  # noqa: BLE001 — relayed, not swallowed
+            put(("err", e))
+
+    t = _threading.Thread(target=pump, daemon=True, name="edl-batch-prefetch")
+    t.start()
+    try:
+        while True:
+            kind, val = q.get()
+            if kind == "item":
+                yield val
+            elif kind == "end":
+                return
+            else:
+                raise val
+    finally:
+        stop.set()
+
+
 class LeaseReader:
     """Iterate (shard, batch) pairs by leasing shards from the coordinator.
 

@@ -350,19 +350,25 @@ class MultiHostWorker:
                 if self.profiler is not None:
                     self.profiler.step(len(next(iter(batch.values()))))
 
+            from edl_tpu.runtime.data import prefetch_iter
             from edl_tpu.runtime.wire import WireRestartRequired
 
             steps = msg.get("steps")
             try:
                 if steps is None:
                     # No batch_count metadata: shards must align by construction.
-                    for batch in self.source.read(shard):
-                        _train_one(batch)
+                    batches = self.source.read(shard)
                 else:
                     # Run exactly `steps` collective steps; cycle a shorter
                     # shard's batches so every rank stays in lockstep.
-                    for batch in self._padded_batches(shard, tasks, steps):
-                        _train_one(batch)
+                    batches = self._padded_batches(shard, tasks, steps)
+                if self.config.prefetch:
+                    # Batch-level read-ahead: shard decompression overlaps
+                    # the jitted step (exception-safe — a SystemExit from
+                    # the padded-batches fallback still reaches this thread).
+                    batches = prefetch_iter(batches)
+                for batch in batches:
+                    _train_one(batch)
             except WireRestartRequired as e:
                 # A batch overflowed the gang-negotiated wire codec; the
                 # widened floor is already published. Same recovery as a

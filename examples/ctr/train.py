@@ -31,6 +31,7 @@ from edl_tpu.runtime import (
     ElasticWorker,
     FileShardSource,
     SyntheticShardSource,
+    pass_tasks,
     write_shard,
 )
 from edl_tpu.runtime.data import shard_names, shard_seed
@@ -55,6 +56,21 @@ def parse_args():
                              "(default: 4 x batch size)")
     parser.add_argument("--even", action="store_true",
                         help="prepare equal-size shards (default: uneven)")
+    # string default: argparse applies `type` to it lazily at parse time, so
+    # a malformed EDL_PASSES yields a clean usage error, not a traceback.
+    parser.add_argument("--num-passes", type=int,
+                        default=os.environ.get("EDL_PASSES", "1"),
+                        help="dataset epochs (ref --num_passes). Cloud mode "
+                             "seeds passes launcher-side from spec.passes; "
+                             "this flag drives the local twin")
+    parser.add_argument("--shuffle-seed", type=int, default=None,
+                        help="deterministic within-shard row shuffle "
+                             "(ref paddle.reader.shuffle, train.py:124-126)")
+    parser.add_argument("--prefetch", action="store_true",
+                        help="load the next shard off-thread while training "
+                             "(ref py_reader double buffering, train.py:120-129)")
+    parser.add_argument("--wire-transport", action="store_true",
+                        help="compact host->device batch codec (bf16/u8/u24)")
     return parser.parse_args()
 
 
@@ -89,7 +105,8 @@ def main() -> None:
     model = ctr.make_model(shard_axis=args.shard_axis,
                            sparse_dim=args.sparse_feature_dim)
     if args.data_dir:
-        source = FileShardSource(root=args.data_dir, batch_size=args.batch_size)
+        source = FileShardSource(root=args.data_dir, batch_size=args.batch_size,
+                                 shuffle_seed=args.shuffle_seed)
     else:
         source = SyntheticShardSource(model, batch_size=args.batch_size,
                                       batches_per_shard=args.batches_per_shard)
@@ -102,22 +119,31 @@ def main() -> None:
         client = wait_coordinator(ctx.coordinator_endpoint)
         client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
         ident = distributed_init(ctx, client)  # multi-host bring-up (None if 1 proc)
+        if args.num_passes != ctx.passes:
+            print(f"note: cloud mode seeds passes launcher-side "
+                  f"(spec.passes={ctx.passes}); --num-passes {args.num_passes} "
+                  f"has no effect here")
     else:  # local twin
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
         coord = InProcessCoordinator()
         if args.data_dir:
-            coord.add_tasks(ctx.data_shards or source.list_shards())
+            shards = ctx.data_shards or source.list_shards()
         else:
-            coord.add_tasks(ctx.data_shards or shard_names("criteo", 4))
+            shards = ctx.data_shards or shard_names("criteo", 4)
+        # Multi-pass: each pass's visit of each shard is its own lease
+        # (ref --num_passes loops the dataset, docker/paddle_k8s:205-216).
+        coord.add_tasks(pass_tasks(shards, args.num_passes))
         client = coord.client("worker-0")
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-ctr-")
 
     cfg = ElasticConfig(
         checkpoint_dir=ctx.checkpoint_dir,
         checkpoint_interval=ctx.checkpoint_interval,
+        prefetch=args.prefetch,
         trainer=TrainerConfig(optimizer="adagrad",
-                              learning_rate=args.learning_rate),
+                              learning_rate=args.learning_rate,
+                              wire_transport=args.wire_transport),
     )
     mesh_axes = {k: v for k, v in ctx.mesh_axes.items() if k != "data"} or None
     if ident is not None:

@@ -379,3 +379,49 @@ def test_zero_step_round_requeues_before_completing(tmp_path):
     st = client.status()
     assert int(st["done"]) == 2 and int(st["queued"]) == 0
     assert metrics["steps"] == 2.0  # 'full' trained exactly its batches
+
+
+def test_prefetch_iter_preserves_order_and_exceptions():
+    """Batch-level read-ahead must be order-identical to plain iteration and
+    re-raise producer exceptions (incl. SystemExit) in the consumer."""
+    from edl_tpu.runtime.data import prefetch_iter
+
+    assert list(prefetch_iter(iter(range(20)))) == list(range(20))
+
+    def boom():
+        yield 1
+        yield 2
+        raise SystemExit(75)
+
+    got = []
+    with pytest.raises(SystemExit) as ei:
+        for x in prefetch_iter(boom()):
+            got.append(x)
+    assert got == [1, 2] and ei.value.code == 75
+
+
+def test_multihost_prefetch_config_trains_identically(tmp_path):
+    """ElasticConfig.prefetch on the lockstep worker: same steps, same
+    completion bookkeeping as the synchronous path."""
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime import ElasticConfig, MultiHostWorker, SyntheticShardSource
+    from edl_tpu.runtime.train_loop import TrainerConfig
+
+    results = {}
+    for tag, prefetch in (("sync", False), ("pre", True)):
+        client = _inproc_client(["p0", "p1", "p2"])
+        w = MultiHostWorker(
+            fit_a_line.MODEL,
+            client,
+            SyntheticShardSource(fit_a_line.MODEL, batch_size=8,
+                                 batches_per_shard=3),
+            ElasticConfig(checkpoint_dir=str(tmp_path / f"ck-{tag}"),
+                          prefetch=prefetch,
+                          trainer=TrainerConfig(optimizer="sgd",
+                                                learning_rate=0.05)),
+        )
+        m = w.run()
+        st = client.status()
+        results[tag] = (m["steps"], m["final_loss"], st["done"], st["queued"])
+    assert results["sync"] == results["pre"]
+    assert results["pre"][2] == 3  # all shards completed
