@@ -552,3 +552,73 @@ def test_heartbeat_renews_leases(coord):
     assert int(st["leased"]) == 1 and int(st["queued"]) == 0, st
     assert a.complete_task("renew0").get("ok") is True
     a.leave()
+
+
+def test_native_durability_random_ops_survive_kill(tmp_path):
+    """Property test for the delta log: after ANY sequence of acked mutations
+    and a kill -9 at an arbitrary point, a restart restores exactly the acked
+    state — done-set and KV match a Python model; every non-done task is
+    (re)leasable. Ack-after-durability makes every kill point equivalent."""
+    if not has_toolchain():
+        pytest.skip("no C++ toolchain")
+    import random
+
+    rng = random.Random(0xED1)
+    for trial in range(3):
+        state = str(tmp_path / f"prop-{trial}.jsonl")
+        model_done, model_kv, model_added = set(), {}, set()
+        server = CoordinatorServer(state_file=state)
+        server.start()
+        port = server.port
+        try:
+            w = server.client("w0")
+            w.register()
+            leased = []
+            n_ops = rng.randrange(40, 120)
+            for i in range(n_ops):
+                op = rng.random()
+                if op < 0.25:
+                    ts = [f"t{trial}-{rng.randrange(60)}" for _ in range(3)]
+                    w.add_tasks(ts)
+                    model_added.update(ts)
+                elif op < 0.45:
+                    t = w.acquire_task()
+                    if t is not None:
+                        leased.append(t)
+                elif op < 0.65 and leased:
+                    t = leased.pop(rng.randrange(len(leased)))
+                    if w.complete_task(t).get("ok"):
+                        model_done.add(t)
+                elif op < 0.75 and leased:
+                    w.fail_task(leased.pop(rng.randrange(len(leased))))
+                elif op < 0.9:
+                    k = f"k{rng.randrange(8)}"
+                    v = f"v{i}"
+                    w.kv_put(k, v)
+                    model_kv[k] = v
+                else:
+                    k = f"k{rng.randrange(8)}"
+                    w.kv_del(k)
+                    model_kv.pop(k, None)
+        finally:
+            server.kill()  # arbitrary kill point: no graceful path
+
+        server2 = CoordinatorServer(port=port, state_file=state)
+        server2.start()
+        try:
+            w = server2.client("w0")
+            w.register()
+            st = w.status()
+            assert int(st["done"]) == len(model_done), (trial, st)
+            for k in (f"k{j}" for j in range(8)):
+                assert w.kv_get(k) == model_kv.get(k), (trial, k)
+            # every added-but-not-done task is leasable exactly once
+            remaining = set()
+            while True:
+                t = w.acquire_task()
+                if t is None:
+                    break
+                remaining.add(t)
+            assert remaining == model_added - model_done, trial
+        finally:
+            server2.stop()

@@ -146,7 +146,12 @@ ctx = LaunchContext.from_env()
 sys.exit(start_trainer(ctx))
 """
 
-    with CoordinatorServer(heartbeat_ttl_sec=5.0) as server:
+    # Generous TTL: warm-restart recompiles (fresh python per incarnation)
+    # can outlast a tight heartbeat window on a loaded single-core box, and
+    # this test's rescale is JOIN-triggered, not expiry-triggered — a member
+    # expiring mid-compile would only inject spurious extra rescales (the
+    # one observed flake mode under full-suite load).
+    with CoordinatorServer(heartbeat_ttl_sec=30.0, task_lease_sec=30.0) as server:
         admin = server.client("admin")
         admin.add_tasks(sorted(rows))
         admin.kv_put("edl/expected_world", "2")
