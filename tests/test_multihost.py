@@ -44,6 +44,22 @@ if os.environ.get("FILE_SHARD_ROOT"):
 else:
     source = SyntheticShardSource(model, batch_size=16,
                                   batches_per_shard=int(os.environ.get("BATCHES_PER_SHARD", "3")))
+_sleep = float(os.environ.get("BATCH_SLEEP", "0"))
+if _sleep:
+    # Throttle for timing-sensitive tests: pins the workload's duration so a
+    # "join mid-run" phase cannot end before the joiner's slow interpreter
+    # startup, however fast the training path gets.
+    class _Throttled:
+        def __init__(self, inner):
+            self.inner = inner
+        def read(self, shard):
+            import time as _t
+            for b in self.inner.read(shard):
+                _t.sleep(_sleep)
+                yield b
+        def batch_count(self, shard):
+            return self.inner.batch_count(shard)
+    source = _Throttled(source)
 worker = MultiHostWorker(
     model,
     client,
@@ -174,9 +190,12 @@ sys.exit(start_trainer(ctx))
 
     with CoordinatorServer(heartbeat_ttl_sec=5.0) as server:
         admin = server.client("admin")
-        # Enough rounds that the solo phase outlives w1's ~6 s process spawn
-        # (steps are ~ms; rounds serialize on coordinator RPCs).
-        admin.add_tasks([f"mh/part-{i:05d}" for i in range(300)])
+        # The solo phase must outlive w1's interpreter+jax startup (tens of
+        # seconds on a loaded box). Wall-clock is pinned by BATCH_SLEEP, not
+        # by hoping training is slow: 120 shards x 40 x 10 ms >= ~48 s solo,
+        # while the done>=2 join gate releases at the first checkpoint
+        # commit (step 1000, ~25 rounds in).
+        admin.add_tasks([f"mh/part-{i:05d}" for i in range(120)])
         admin.kv_put("edl/expected_world", "1")
 
         def spawn_launcher(name):
@@ -187,6 +206,7 @@ sys.exit(start_trainer(ctx))
             env["WORKER_NAME"] = name
             env["CKPT_DIR"] = ckpt
             env["BATCHES_PER_SHARD"] = "40"
+            env["BATCH_SLEEP"] = "0.01"
             env["EDL_TERMINATION_LOG"] = str(tmp_path / f"term-{name}")
             return subprocess.Popen(
                 [sys.executable, "-c", launcher_src], env=env,
