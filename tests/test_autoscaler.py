@@ -204,3 +204,141 @@ def test_make_room_for_pending_job():
         scaler.step()
     assert cluster.get_trainer_parallelism("hog") < 4
     assert all(p.phase == "Running" for p in cluster.job_pods("newbie"))
+
+
+# -- property tests: invariants of the pure dry-run core -----------------------
+
+
+def _random_cluster(rng, n_nodes):
+    from edl_tpu.controller.cluster import ClusterResource
+
+    node_idle = {}
+    total = ResourceList()
+    for i in range(n_nodes):
+        cap = ResourceList.make({
+            "cpu": float(rng.choice([8, 16, 32])),
+            "memory": float(rng.choice([2, 4, 8])) * 2**30,
+            "tpu": float(rng.choice([0, 4, 4, 8])),
+        })
+        node_idle[f"n{i}"] = cap.copy()
+        total.add(cap)
+    return ClusterResource(total=total, requested=ResourceList(),
+                           node_idle=node_idle)
+
+
+def _random_job(rng, i):
+    lo = int(rng.integers(1, 4))
+    hi = lo + int(rng.integers(0, 8))
+    job = TrainingJob.from_dict({
+        "metadata": {"name": f"j{i}"},
+        "spec": {
+            "tpu": {"chips_per_trainer": int(rng.choice([0, 4, 4, 8]))},
+            "trainer": {
+                "min_instance": lo, "max_instance": hi,
+                "resources": {"requests": {
+                    "cpu": str(int(rng.integers(1, 4))),
+                    "memory": f"{int(rng.integers(1, 3))}Gi",
+                }},
+            },
+        },
+    })
+    # current anywhere in [lo, hi]: above-floor starts make the scale-DOWN
+    # arm reachable (an at-floor-only population can never shrink, which
+    # would leave the floor invariant vacuously true).
+    return JobState(job=job, current=int(rng.integers(lo, hi + 1)))
+
+
+def test_scale_all_dry_run_invariants_random():
+    """Random clusters x random elastic jobs: the fixed-point plan never
+    exceeds max_instance, never shrinks below min(current, min_instance),
+    never over-commits TPU chips when starting feasible, never worsens an
+    infeasible start, and is deterministic. Some trials start deliberately
+    OVER-committed — inquire counts PENDING pods' requests too, which is
+    exactly what trips the scale-down arm."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    downs = 0
+    for trial in range(60):
+        resource = _random_cluster(rng, int(rng.integers(1, 6)))
+        states = [_random_job(rng, i) for i in range(int(rng.integers(1, 5)))]
+        # Account the initial replicas as inquire would: place what fits on
+        # nodes; with some probability keep the remainder as PENDING pods —
+        # their requests count against the ceiling but hold no node.
+        placed_states = []
+        for s in states:
+            pending_ok = rng.random() < 0.4
+            placed = 0
+            for _ in range(s.current):
+                node = resource.search_assignable_node(s.request())
+                if node is None:
+                    if pending_ok:
+                        resource.requested.add(s.request())
+                        placed += 1
+                    continue
+                resource.assign(node, s.request())
+                placed += 1
+            s.current = placed
+            if placed:
+                placed_states.append(s)
+        if not placed_states:
+            continue
+        states = placed_states
+
+        diff = scale_all_dry_run(resource.copy(), states, max_load_desired=0.9)
+        again = scale_all_dry_run(resource.copy(), states, max_load_desired=0.9)
+        assert diff == again  # deterministic
+        downs += sum(1 for v in diff.values() if v < 0)
+
+        tpu_before = resource.requested.get_q("tpu")
+        tpu_after = tpu_before
+        for s in states:
+            final = s.current + diff[s.name]
+            assert final <= s.max_instance(), (trial, s.name, diff)
+            assert final >= min(s.current, s.min_instance()), (trial, s.name, diff)
+            tpu_after += diff[s.name] * s.request().get_q("tpu")
+        # started feasible -> ends feasible; started over-committed -> the
+        # plan must not be worse than the start
+        cap = max(tpu_before, resource.total.get_q("tpu"))
+        assert tpu_after <= cap + 1e-9, (trial, diff)
+    # the population genuinely reaches the scale-down arm (non-vacuous)
+    assert downs > 0
+
+
+def test_make_room_dry_run_invariants_random():
+    """make-room only ever shrinks, never below any job's floor, and
+    terminates on arbitrary pending sets."""
+    import numpy as np
+
+    from edl_tpu.controller.autoscaler import make_room_dry_run
+
+    rng = np.random.default_rng(11)
+    for trial in range(40):
+        resource = _random_cluster(rng, int(rng.integers(1, 6)))
+        states = []
+        for i in range(int(rng.integers(1, 5))):
+            s = _random_job(rng, i)
+            s.current = int(rng.integers(s.min_instance(), s.max_instance() + 1))
+            placed = 0
+            for _ in range(s.current):
+                node = resource.search_assignable_node(s.request())
+                if node is None:
+                    break
+                resource.assign(node, s.request())
+                placed += 1
+            s.current = placed
+            if placed:
+                states.append(s)
+        if not states:
+            continue
+        pending = [
+            ResourceList.make({"cpu": str(int(rng.integers(1, 8))),
+                               "tpu": float(rng.choice([0, 4, 8]))})
+            for _ in range(int(rng.integers(1, 4)))
+        ]
+        diff = make_room_dry_run(resource.copy(), states, pending)
+        for s in states:
+            assert diff[s.name] <= 0, (trial, diff)
+            assert s.current + diff[s.name] >= min(s.current, s.min_instance()), (
+                trial, s.name, diff,
+            )
