@@ -93,7 +93,16 @@ def main() -> None:
     n_shards = int(os.environ.get("EDL_RESCALE_SHARDS", "12"))
     batches_per_shard = int(os.environ.get("EDL_RESCALE_BPS", "24"))
     model = fit_a_line.MODEL
-    devs = jax.devices()
+    on_cpu_sim = os.environ.get("EDL_RESCALE_PLATFORM", "cpu") == "cpu"
+    from bench import probe_devices  # shared deadline + CPU-fallback guard
+
+    devs, reason = probe_devices(
+        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
+        allow_cpu=on_cpu_sim,
+    )
+    if devs is None:
+        print(json.dumps({"error": reason}))
+        raise SystemExit(1)
     full = len(devs)  # 8 on the simulation mesh
     half = max(1, full // 2)
     tcfg = TrainerConfig(optimizer="sgd", learning_rate=0.05)

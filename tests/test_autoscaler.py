@@ -210,20 +210,21 @@ def test_make_room_for_pending_job():
 
 
 def _random_cluster(rng, n_nodes):
-    from edl_tpu.controller.cluster import ClusterResource
-
-    node_idle = {}
-    total = ResourceList()
-    for i in range(n_nodes):
-        cap = ResourceList.make({
-            "cpu": float(rng.choice([8, 16, 32])),
-            "memory": float(rng.choice([2, 4, 8])) * 2**30,
-            "tpu": float(rng.choice([0, 4, 4, 8])),
-        })
-        node_idle[f"n{i}"] = cap.copy()
-        total.add(cap)
-    return ClusterResource(total=total, requested=ResourceList(),
-                           node_idle=node_idle)
+    # Through the production snapshot path (inquire_resource), not a
+    # hand-assembled ClusterResource — so the property tests exercise the
+    # exact cluster shape the controller derives.
+    nodes = [
+        NodeInfo(
+            name=f"n{i}",
+            allocatable=ResourceList.make({
+                "cpu": float(rng.choice([8, 16, 32])),
+                "memory": float(rng.choice([2, 4, 8])) * 2**30,
+                "tpu": float(rng.choice([0, 4, 4, 8])),
+            }),
+        )
+        for i in range(n_nodes)
+    ]
+    return snapshot(nodes)
 
 
 def _random_job(rng, i):
