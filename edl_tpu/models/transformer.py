@@ -62,6 +62,12 @@ class TransformerConfig:
     pp_axis: str = "pipe"
     #: microbatches for the pipeline schedule; None = stage count.
     microbatches: Optional[int] = None
+    #: per-block rematerialization (`jax.checkpoint` around each block under
+    #: the scan): the backward pass recomputes block activations instead of
+    #: storing them, cutting live activation memory from O(n_layers) to O(1)
+    #: per stage — the standard HBM-for-FLOPs trade that makes long-context
+    #: training fit (scaling-book recipe; the reference has no analog).
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -202,10 +208,19 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
     x = params["embed"][tokens] + params["pos"][pos]
     x = x.astype(jnp.bfloat16)
 
+    block_fn = partial(_block, cfg, mesh, n_sp)
+    if cfg.remat:
+        # Checkpoint at block granularity: under the scan this stores only
+        # each block's INPUT carry and recomputes its internals in backward.
+        # prevent_cse=False: scan already provides the staging that makes
+        # checkpoint's CSE barriers necessary elsewhere; keeping them would
+        # block XLA fusion inside the block body for nothing.
+        block_fn = jax.checkpoint(block_fn, prevent_cse=False)
+
     def stage(blocks_local, h):
         """Apply this rank's chunk of blocks (whole stack when pp absent)."""
         h, _ = jax.lax.scan(
-            lambda c, bp: (_block(cfg, mesh, n_sp, c, bp), None),
+            lambda c, bp: (block_fn(c, bp), None),
             h,
             blocks_local,
         )
