@@ -47,6 +47,14 @@ class TrainerConfig:
     #: extra batch keys (besides the model's label_keys) that must never get
     #: a lossy wire encoding — e.g. per-sample weights fed to the loss.
     wire_raw_keys: Tuple[str, ...] = ()
+    #: ZeRO-1: shard REPLICATED optimizer-state tensors (adam/adagrad
+    #: moments) over the batch axis. Each chip then holds 1/N of the moments
+    #: instead of a full copy; XLA SPMD partitions the elementwise optimizer
+    #: update along the moment sharding and all-gathers the param update —
+    #: HBM for one cheap data-axis collective per step. Param and gradient
+    #: layouts are untouched, so the math is identical. Already-sharded
+    #: moments (e.g. row-sharded embedding tables') keep their sharding.
+    shard_opt_state: bool = False
 
 
 def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -92,6 +100,23 @@ class Trainer:
             loss, grads = jax.value_and_grad(model.loss_fn)(state.params, batch, mesh)
             updates, opt_state = self.opt.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
+            if self.config.shard_opt_state and model.param_spec is not None:
+                # ZeRO-1 boundary: without this pin, XLA's sharding
+                # propagation would push the moments' data-axis sharding
+                # onto the updated params too (drifting toward an implicit
+                # ZeRO-3). Params keep their canonical layout; only the
+                # optimizer state stays sharded.
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+
+                params = jax.tree_util.tree_map(
+                    lambda p, s: jax.lax.with_sharding_constraint(
+                        p, NamedSharding(mesh, s)
+                    ),
+                    params,
+                    model.param_spec(mesh),
+                    is_leaf=lambda x: isinstance(x, P),
+                )
             return TrainState(state.step + 1, params, opt_state), loss
 
         # Input shardings flow from the state/batch placements; XLA SPMD
@@ -109,7 +134,55 @@ class Trainer:
         # Under jit, zeros_like/moment init inherits each param's sharding, so
         # optimizer state for a row-sharded table is row-sharded too.
         opt_state = jax.jit(self.opt.init)(params)
+        # Gate on param_spec exactly like the step-boundary pin: sharding the
+        # moments WITHOUT being able to pin params would let XLA propagation
+        # push the data-axis layout onto the params (implicit ZeRO-3 drift).
+        if self.config.shard_opt_state and self.model.param_spec is not None:
+            opt_state = self._shard_opt_state(opt_state)
         return TrainState(jnp.zeros((), jnp.int32), params, opt_state)
+
+    def _shard_opt_state(self, opt_state: Any) -> Any:
+        """ZeRO-1 placement: re-shard replicated moment tensors over the
+        batch axis (first divisible dim). Leaves that already carry a real
+        sharding (moments of sharded params) and scalars are untouched."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axis = self.config.batch_axis
+        if axis not in self.mesh.axis_names:
+            return opt_state
+        n = self.mesh.shape[axis]
+
+        def target_sharding(x):
+            """New sharding for leaves that should reshard; None otherwise.
+            Unchanged leaves must NOT pass through device_put — it would
+            COMMIT previously-uncommitted arrays (e.g. optimizer counts) to
+            their current device and poison the jit with device conflicts."""
+            if not hasattr(x, "sharding") or x.ndim == 0:
+                return None
+            sh = x.sharding
+            replicated = (
+                isinstance(sh, NamedSharding)
+                and all(s is None for s in sh.spec)
+            ) or getattr(sh, "is_fully_replicated", False)
+            if not replicated:
+                return None  # already sharded (e.g. embedding-table moments)
+            for dim, size in enumerate(x.shape):
+                if size % n == 0 and size > 0:
+                    spec = [None] * x.ndim
+                    spec[dim] = axis
+                    return NamedSharding(self.mesh, P(*spec))
+            return None  # no divisible dim: stays replicated
+
+        # One batched device_put over just the resharded leaves (the
+        # codebase's placement convention — see parallel/sharding.py).
+        flat, treedef = jax.tree_util.tree_flatten(opt_state)
+        targets = [target_sharding(x) for x in flat]
+        to_move = [x for x, t in zip(flat, targets) if t is not None]
+        if not to_move:
+            return opt_state
+        moved = iter(jax.device_put(to_move, [t for t in targets if t is not None]))
+        out = [next(moved) if t is not None else x for x, t in zip(flat, targets)]
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     # -- stepping --------------------------------------------------------------
 
