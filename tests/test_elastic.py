@@ -384,3 +384,46 @@ def test_elastic_worker_wire_overflow_exits_for_warm_restart(tmp_path, monkeypat
     # durable flush happened: the fully-consumed first shard committed
     st = client.status()
     assert int(st["done"]) == 1, st
+
+
+def test_zero1_checkpoint_restores_across_mesh_sizes(tmp_path):
+    """ZeRO-1 moments (data-axis sharded) must survive the rescale path:
+    save on a 4-device mesh, restore on 8 — orbax reshards into the NEW
+    mesh's ZeRO layout (live_state_specs of a fresh init carries it), and
+    training resumes."""
+    from jax.sharding import NamedSharding
+
+    model = small_ctr()
+    cfg = TrainerConfig(optimizer="adam", shard_opt_state=True)
+    rng = np.random.default_rng(3)
+
+    mesh4 = build_mesh(MeshSpec({"data": 4}), jax.devices()[:4])
+    tr4 = Trainer(model, mesh4, cfg)
+    state4 = tr4.init_state()
+    for _ in range(2):
+        state4, _ = tr4.train_step(
+            state4, tr4.place_batch(model.synthetic_batch(rng, 16))
+        )
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    ckpt.save(int(state4.step), state4)
+    ckpt.wait()
+
+    mesh8 = build_mesh(MeshSpec({"data": 8}))
+    tr8 = Trainer(model, mesh8, cfg)
+    fresh8 = tr8.init_state()
+    state8 = ckpt.restore(abstract_like(fresh8), mesh8, live_state_specs(fresh8))
+    assert int(state8.step) == 2
+
+    # restored moments carry the 8-way ZeRO layout
+    sharded = [
+        leaf for leaf in jax.tree_util.tree_leaves(state8.opt_state)
+        if isinstance(getattr(leaf, "sharding", None), NamedSharding)
+        and any(s is not None for s in leaf.sharding.spec)
+    ]
+    assert sharded, "restored optimizer state lost its ZeRO sharding"
+    # and training continues
+    state8, loss = tr8.train_step(
+        state8, tr8.place_batch(model.synthetic_batch(rng, 16))
+    )
+    assert np.isfinite(float(loss))
+    ckpt.close()
