@@ -126,7 +126,11 @@ def main() -> None:
     else:  # local twin
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
-        coord = InProcessCoordinator()
+        # Single local worker: lease expiry can only duplicate work, and the
+        # first jit compile can stall past the 16 s default with no heartbeat
+        # in between — compile-stall-tolerant leases avoid spurious replays.
+        coord = InProcessCoordinator(task_lease_sec=300.0,
+                                     heartbeat_ttl_sec=300.0)
         if args.data_dir:
             shards = ctx.data_shards or source.list_shards()
         else:

@@ -1,0 +1,123 @@
+"""Transformer LM elastic trainer — the long-context / multi-axis capstone.
+
+No reference analog (the reference's model zoo tops out at a 5-gram embedding
+window, `example/fit_a_line/train_ft.py:26`); this example exists because a
+TPU-native framework's flagship workload is a transformer whose mesh layout
+composes every axis the parallel layer ships:
+
+    data   — batch sharding (gradients psum over ICI)
+    seq    — ring-attention sequence/context parallelism for long inputs
+    model  — megatron tensor parallelism
+    pipe   — GPipe pipeline stages
+
+plus the two HBM levers: per-block rematerialization (``--remat``) and
+ZeRO-1 optimizer-state sharding (``--zero1``).
+
+Mesh axes come from ``EDL_MESH_AXES`` (the controller's env protocol) or
+``--axes``; unlisted chips fold into the data axis. Runs standalone (no env):
+spawns an in-process coordinator and trains the whole queue on the local
+device mesh.
+
+    python examples/lm/train.py --axes '{"seq": 2, "model": 2}' \
+        --seq-len 512 --remat --zero1
+"""
+
+import argparse
+import json
+import os
+import tempfile
+
+from edl_tpu.launcher.launch import LaunchContext
+from edl_tpu.models import transformer
+from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
+from edl_tpu.runtime.data import pass_tasks, shard_names
+from edl_tpu.runtime.train_loop import TrainerConfig
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="Transformer LM elastic training")
+    p.add_argument("--vocab-size", type=int, default=8192)
+    p.add_argument("--d-model", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--n-heads", type=int, default=8)
+    p.add_argument("--d-ff", type=int, default=1024)
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--batches-per-shard", type=int, default=4)
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--axes", default=os.environ.get("EDL_MESH_AXES", "{}"),
+                   help='non-data mesh axes, e.g. \'{"seq":2,"model":2}\'')
+    p.add_argument("--remat", action="store_true",
+                   help="per-block activation rematerialization")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard optimizer moments over the data axis")
+    p.add_argument("--num-passes", type=int,
+                   default=os.environ.get("EDL_PASSES", "1"))
+    return p.parse_args()
+
+
+def main() -> None:
+    args = parse_args()
+    ctx = LaunchContext.from_env()
+    # Drop the data axis: workers size it from their device count (world x
+    # chips / fixed axes) — passing it through would double-count it in
+    # _build_mesh (same rule as ctr/train.py).
+    axes = {k: int(v) for k, v in json.loads(args.axes).items()
+            if k != "data" and int(v) > 1}
+    model = transformer.make_model(
+        vocab_size=args.vocab_size, d_model=args.d_model,
+        n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
+        seq_len=args.seq_len, remat=args.remat,
+    )
+    source = SyntheticShardSource(model, batch_size=args.batch_size,
+                                  batches_per_shard=args.batches_per_shard)
+
+    if os.environ.get("EDL_COORDINATOR_ENDPOINT"):  # cloud mode
+        from edl_tpu.launcher.discovery import wait_coordinator
+        from edl_tpu.runtime.distributed import distributed_init
+
+        client = wait_coordinator(ctx.coordinator_endpoint)
+        client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
+        ident = distributed_init(ctx, client)
+        if int(args.num_passes) != ctx.passes:
+            print(f"note: cloud mode seeds passes launcher-side "
+                  f"(spec.passes={ctx.passes}); --num-passes "
+                  f"{args.num_passes} has no effect here")
+    else:  # local twin
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        ident = None
+        # Single local worker: a lease expiring can only duplicate work, and
+        # the first jit compile (remat especially) can stall tens of seconds
+        # with no heartbeat in between — so leases are compile-stall tolerant.
+        coord = InProcessCoordinator(task_lease_sec=300.0,
+                                     heartbeat_ttl_sec=300.0)
+        coord.add_tasks(pass_tasks(
+            ctx.data_shards or shard_names("lm", args.shards),
+            int(args.num_passes),
+        ))
+        client = coord.client("worker-0")
+        ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-lm-")
+
+    cfg = ElasticConfig(
+        checkpoint_dir=ctx.checkpoint_dir,
+        checkpoint_interval=ctx.checkpoint_interval,
+        trainer=TrainerConfig(optimizer="adam",
+                              learning_rate=args.learning_rate,
+                              shard_opt_state=args.zero1),
+    )
+    if ident is not None:
+        from edl_tpu.runtime import MultiHostWorker
+
+        worker = MultiHostWorker(model, client, source, cfg,
+                                 mesh_axes=axes or None)
+    else:
+        worker = ElasticWorker(model, client, source, cfg,
+                               mesh_axes=axes or None)
+    metrics = worker.run()
+    print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,11 +40,30 @@ def test_mnist_train_then_infer(tmp_path):
     assert inf["accuracy"] > 0.9  # synthetic quadrant digits are separable
 
 
+def test_lm_multi_axis_standalone():
+    """The transformer-LM capstone: dp x sp x tp mesh with remat + ZeRO-1 +
+    multi-pass, through the elastic worker's local twin."""
+    out = run_example(
+        "examples/lm/train.py",
+        "--axes", '{"seq": 2, "model": 2}',
+        "--vocab-size", "128", "--d-model", "32", "--n-layers", "2",
+        "--d-ff", "64", "--seq-len", "32", "--shards", "2",
+        "--batches-per-shard", "2", "--remat", "--zero1",
+        "--num-passes", "2", timeout=420,
+    )
+    assert out["steps"] == 8.0  # 2 shards x 2 batches x 2 passes
+    assert out["passes_trained"] == 2.0
+    import math
+
+    assert out["final_loss"] < math.log(128) + 0.5  # near-uniform start
+
+
 @pytest.mark.parametrize("yaml_path", [
     "examples/fit_a_line/job.yaml",
     "examples/ctr/job.yaml",
     "examples/word2vec/job.yaml",
     "examples/mnist/job.yaml",
+    "examples/lm/job.yaml",
 ])
 def test_job_yamls_pass_admission(yaml_path):
     env = dict(os.environ)
