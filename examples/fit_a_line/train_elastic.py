@@ -36,7 +36,11 @@ def main() -> None:
     else:  # hermetic demo mode
         from edl_tpu.coordinator.inprocess import InProcessCoordinator
 
-        coord = InProcessCoordinator()
+        # Single local worker: compile-stall-tolerant leases (a first jit can
+        # outlast the 16 s default with no heartbeat in between; expiry would
+        # only duplicate work here).
+        coord = InProcessCoordinator(task_lease_sec=300.0,
+                                     heartbeat_ttl_sec=300.0)
         coord.add_tasks(ctx.data_shards or shard_names("uci", 8))
         client = coord.client("worker-0")
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-fit-")
