@@ -24,7 +24,7 @@ data values.
 """
 
 from edl_tpu.models.base import Model
-from edl_tpu.models import fit_a_line, mnist, word2vec, ctr, transformer
+from edl_tpu.models import fit_a_line, mnist, word2vec, ctr, resnet, transformer
 
 
 _REGISTRY = {
@@ -32,6 +32,7 @@ _REGISTRY = {
     "mnist": mnist.MODEL,
     "word2vec": word2vec.MODEL,
     "ctr": ctr.MODEL,
+    "resnet50": resnet.MODEL,
     "transformer": transformer.MODEL,
 }
 
@@ -43,4 +44,5 @@ def get(name: str) -> Model:
     return _REGISTRY[name]
 
 
-__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "transformer", "word2vec"]
+__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "resnet",
+           "transformer", "word2vec"]

@@ -1,0 +1,125 @@
+"""ResNet-50 elastic image-classification trainer.
+
+The driver brief's vision configuration (`BASELINE.json` configs:
+"ResNet-50 / ImageNet (data-parallel, elastic 4<->16 TPU workers)") — the
+reference repo ships no vision workload, so this example extends the zoo
+rather than twinning a reference file. Structure mirrors the other elastic
+examples: coordinator-leased shards, checkpoint-restore rescale, and a
+train / infer mode split like `examples/mnist/train.py` (the reference's
+save-inference-then-infer pattern, `recognize_digits.py:147-173`).
+
+Defaults use the TINY config (32px, width 8, 10 classes) so the example
+runs on a CPU mesh; ``--imagenet`` selects the full ResNet-50/224px/1000
+configuration for real chips.
+"""
+
+import argparse
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from edl_tpu.launcher.launch import LaunchContext
+from edl_tpu.models import resnet
+from edl_tpu.runtime import ElasticConfig, ElasticWorker, SyntheticShardSource
+from edl_tpu.runtime.data import shard_names
+from edl_tpu.runtime.train_loop import TrainerConfig
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="ResNet elastic training")
+    p.add_argument("mode", nargs="?", default="train", choices=["train", "infer"])
+    p.add_argument("--imagenet", action="store_true",
+                   help="full ResNet-50/224px/1000-class configuration")
+    p.add_argument("--depth", type=int, default=50, choices=sorted(resnet._STAGES))
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--batches-per-shard", type=int, default=10)
+    p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--model-dir", default=None)
+    return p.parse_args()
+
+
+def make_model(args):
+    if args.imagenet:
+        cfg = resnet.ResNetConfig(depth=args.depth)
+    else:
+        cfg = resnet.ResNetConfig(
+            depth=args.depth, num_classes=resnet.TINY.num_classes,
+            image_size=resnet.TINY.image_size, width=resnet.TINY.width,
+            gn_groups=resnet.TINY.gn_groups,
+        )
+    return resnet.make_model(cfg)
+
+
+def train(args) -> None:
+    ctx = LaunchContext.from_env()
+    # Launcher-provided durable dir (EDL_CHECKPOINT_DIR from job.yaml) wins
+    # over the fixed /tmp fallback (fixed so a flagless `train` then
+    # `infer` round-trips), like the sibling elastic examples.
+    model_dir = (args.model_dir or ctx.checkpoint_dir
+                 or tempfile.gettempdir() + "/edl-resnet-ckpt")
+    model = make_model(args)
+    source = SyntheticShardSource(model, batch_size=args.batch_size,
+                                 batches_per_shard=args.batches_per_shard)
+
+    if os.environ.get("EDL_COORDINATOR_ENDPOINT"):
+        from edl_tpu.launcher.discovery import wait_coordinator
+
+        client = wait_coordinator(ctx.coordinator_endpoint)
+        client.worker = f"{ctx.job_name}-worker-{os.getpid()}"
+    else:  # hermetic demo mode
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        # Compile-stall-tolerant leases: a ResNet first-jit can outlast the
+        # 16 s production lease with no heartbeat in between.
+        coord = InProcessCoordinator(task_lease_sec=600.0,
+                                     heartbeat_ttl_sec=600.0)
+        coord.add_tasks(ctx.data_shards or shard_names("imagenet", 6))
+        client = coord.client("worker-0")
+
+    cfg = ElasticConfig(
+        checkpoint_dir=model_dir,
+        checkpoint_interval=ctx.checkpoint_interval,
+        trainer=TrainerConfig(optimizer="adam",
+                              learning_rate=args.learning_rate),
+    )
+    worker = ElasticWorker(model, client, source, cfg)
+    metrics = worker.run()
+    print(json.dumps({**{k: round(v, 4) for k, v in metrics.items()},
+                      "model_dir": model_dir}))
+
+
+def infer(args) -> None:
+    model_dir = (args.model_dir or os.environ.get("EDL_CHECKPOINT_DIR")
+                 or tempfile.gettempdir() + "/edl-resnet-ckpt")
+    from edl_tpu.parallel import local_mesh
+    from edl_tpu.runtime import Trainer
+    from edl_tpu.runtime.checkpoint import (
+        Checkpointer, abstract_like, live_state_specs,
+    )
+
+    model = make_model(args)
+    mesh = local_mesh()
+    trainer = Trainer(model, mesh, TrainerConfig())
+    fresh = trainer.init_state()
+    ckpt = Checkpointer(model_dir)
+    if ckpt.latest_step() is None:
+        raise SystemExit(f"no checkpoint under {model_dir}; run train first")
+    state = ckpt.restore(abstract_like(fresh), mesh, live_state_specs(fresh))
+    batch = model.synthetic_batch(np.random.default_rng(99), 128)
+    placed = trainer.place_batch(batch)
+    acc = float(resnet.accuracy(model, state.params, placed))
+    print(json.dumps({"step": int(state.step), "accuracy": round(acc, 4)}))
+
+
+def main() -> None:
+    args = parse_args()
+    if args.mode == "train":
+        train(args)
+    else:
+        infer(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,6 +40,21 @@ def test_mnist_train_then_infer(tmp_path):
     assert inf["accuracy"] > 0.9  # synthetic quadrant digits are separable
 
 
+def test_resnet_train_then_infer(tmp_path):
+    """The BASELINE.json vision config's example: elastic ResNet (tiny
+    config) trains through coordinator leases, then infer mode restores
+    the checkpoint and classifies above chance."""
+    model_dir = str(tmp_path / "ck")
+    out = run_example("examples/resnet/train.py", "train",
+                      "--batch-size", "32", "--batches-per-shard", "4",
+                      "--model-dir", model_dir, timeout=420)
+    assert out["steps"] == 24.0  # 6 shards x 4 batches
+    assert out["final_loss"] < 2.0  # well below uniform log(10) ~ 2.30
+    inf = run_example("examples/resnet/train.py", "infer",
+                      "--model-dir", model_dir)
+    assert inf["accuracy"] > 0.2  # 10 classes; separable patterns
+
+
 def test_lm_multi_axis_standalone():
     """The transformer-LM capstone: dp x sp x tp mesh with remat + ZeRO-1 +
     multi-pass, through the elastic worker's local twin."""

@@ -1,0 +1,104 @@
+"""ResNet model family: architecture fidelity + training on a DP mesh.
+
+The vision configuration from BASELINE.json ("ResNet-50 / ImageNet,
+data-parallel, elastic 4<->16 TPU workers"); no reference twin exists
+(wopeizl/edl ships no vision models), so fidelity is checked against the
+canonical ResNet-50 parameter count instead of a reference file.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from edl_tpu.models import resnet
+from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.runtime import Trainer, TrainerConfig
+
+
+def _param_count(model, mesh) -> int:
+    shapes = jax.eval_shape(lambda k: model.init(k, mesh), jax.random.PRNGKey(0))
+    return sum(int(np.prod(s.shape)) for s in jax.tree_util.tree_leaves(shapes))
+
+
+def test_resnet50_canonical_param_count():
+    """25,557,032 — the canonical ResNet-50 count. GroupNorm's scale/bias
+    match BatchNorm's affine params exactly (running stats are not
+    trainable), so the substitution is count-preserving."""
+    mesh = Mesh(np.array(jax.devices()[:1]), axis_names=("data",))
+    assert _param_count(resnet.MODEL, mesh) == 25_557_032
+
+
+def test_resnet18_basic_blocks_build():
+    mesh = Mesh(np.array(jax.devices()[:1]), axis_names=("data",))
+    model = resnet.make_model(depth=18, num_classes=10, image_size=32,
+                              width=8, gn_groups=4)
+    shapes = jax.eval_shape(lambda k: model.init(k, mesh), jax.random.PRNGKey(0))
+    # basic blocks have no conv3
+    assert "conv3" not in shapes["blocks"][0]
+    assert "proj" not in shapes["blocks"][0]  # stage 0 block 0: same shape
+    # first block of stage 1 downsamples -> needs the projection shortcut
+    assert "proj" in shapes["blocks"][2]
+
+
+def test_param_spec_structure_matches_params():
+    mesh = Mesh(np.array(jax.devices()[:1]), axis_names=("data",))
+    for model in (resnet.MODEL, resnet.make_model(resnet.TINY),
+                  resnet.make_model(depth=18)):
+        shapes = jax.eval_shape(lambda k: model.init(k, mesh),
+                                jax.random.PRNGKey(0))
+        spec = model.param_spec(mesh)
+        assert (jax.tree_util.tree_structure(spec)
+                == jax.tree_util.tree_structure(shapes))
+
+
+def test_tiny_resnet_trains_on_dp_mesh():
+    model = resnet.make_model(resnet.TINY)
+    mesh = build_mesh(MeshSpec({"data": len(jax.devices())}))
+    trainer = Trainer(model, mesh,
+                      TrainerConfig(optimizer="adam", learning_rate=1e-3))
+    state = trainer.init_state()
+    rng = np.random.default_rng(0)
+    first = last = None
+    for _ in range(10):
+        state, loss = trainer.train_step(
+            state, trainer.place_batch(model.synthetic_batch(rng, 32))
+        )
+        first = float(loss) if first is None else first
+        last = float(loss)
+    assert np.isfinite(last)
+    assert last < first  # learns the synthetic frequency patterns
+    acc = float(resnet.accuracy(model, state.params,
+                                model.synthetic_batch(rng, 128)))
+    assert acc > 2.0 / model.config.num_classes  # clearly above chance
+
+
+def test_forward_batch_invariance():
+    """Same example alone vs inside a batch -> same logits (GroupNorm is
+    batch-independent; BatchNorm would fail this, which is why it was
+    swapped out for the elastic world)."""
+    model = resnet.make_model(resnet.TINY)
+    mesh = Mesh(np.array(jax.devices()[:1]), axis_names=("data",))
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    batch = model.synthetic_batch(np.random.default_rng(1), 8)
+    full = np.asarray(resnet.forward(model, params, batch["image"]))
+    solo = np.asarray(resnet.forward(model, params, batch["image"][:1]))
+    np.testing.assert_allclose(full[:1], solo, rtol=2e-4, atol=2e-4)
+
+
+def test_loss_identical_across_mesh_sizes():
+    """1-device vs 8-device DP mesh produce the same loss for the same
+    params/batch (SPMD partitioning must not change the math)."""
+    model = resnet.make_model(resnet.TINY)
+    rng = np.random.default_rng(2)
+    batch = model.synthetic_batch(rng, 16)
+    losses = []
+    for n in (1, len(jax.devices())):
+        mesh = build_mesh(MeshSpec({"data": n}), jax.devices()[:n])
+        trainer = Trainer(model, mesh, TrainerConfig(optimizer="sgd",
+                                                     learning_rate=1e-2))
+        state = trainer.init_state()
+        _, loss = trainer.train_step(state, trainer.place_batch(batch))
+        losses.append(float(loss))
+    assert losses[0] == pytest.approx(losses[1], rel=1e-4)
