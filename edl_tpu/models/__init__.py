@@ -27,14 +27,18 @@ from edl_tpu.models.base import Model
 from edl_tpu.models import fit_a_line, mnist, word2vec, ctr, resnet, transformer
 
 
-_REGISTRY = {
-    "fit_a_line": fit_a_line.MODEL,
-    "mnist": mnist.MODEL,
-    "word2vec": word2vec.MODEL,
-    "ctr": ctr.MODEL,
-    "resnet50": resnet.MODEL,
-    "transformer": transformer.MODEL,
+_MODULES = {
+    "fit_a_line": fit_a_line,
+    "mnist": mnist,
+    "word2vec": word2vec,
+    "ctr": ctr,
+    "resnet": resnet,
+    "transformer": transformer,
 }
+
+#: default instances, keyed by each model's own name (module name and
+#: model name differ where one module serves a family: resnet -> resnet50)
+_REGISTRY = {mod.MODEL.name: mod.MODEL for mod in _MODULES.values()}
 
 
 def get(name: str) -> Model:
@@ -44,5 +48,21 @@ def get(name: str) -> Model:
     return _REGISTRY[name]
 
 
-__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "resnet",
+def resolve(ref: str, config=None) -> Model:
+    """Rebuild a zoo model from (module ref, make_model kwargs) — the model
+    half of an inference artifact (`runtime.export`). ``ref`` names a zoo
+    module; with no config, registry names (e.g. ``resnet50``) work too."""
+    if not config:
+        if ref in _MODULES:
+            return _MODULES[ref].MODEL
+        return get(ref)
+    if ref not in _MODULES:
+        raise KeyError(f"unknown model module {ref!r}; have {sorted(_MODULES)}")
+    mod = _MODULES[ref]
+    if not hasattr(mod, "make_model"):
+        raise TypeError(f"model {ref!r} is not configurable (no make_model)")
+    return mod.make_model(**config)
+
+
+__all__ = ["Model", "ctr", "fit_a_line", "get", "mnist", "resnet", "resolve",
            "transformer", "word2vec"]

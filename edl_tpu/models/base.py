@@ -45,3 +45,11 @@ class Model:
     #: regression target consumed by a float32 loss must cross exactly
     #: (integer labels keep their exact u8/u24 encodings).
     label_keys: Tuple[str, ...] = ()
+    #: optional inference entrypoint (params, batch, mesh) -> outputs, the
+    #: serving twin of loss_fn (jit-traceable; batch omits label keys).
+    #: Drives `runtime.export.load_inference_model(...).predict` — the
+    #: reference's save_inference_model program (`ctr/train.py:169-180`).
+    predict: Optional[Callable] = None
+    #: optional structured config the model was built from (e.g. a
+    #: ResNetConfig/TransformerConfig) for forward helpers and export.
+    config: Optional[Any] = None

@@ -140,6 +140,11 @@ def make_model(
         param_spec=lambda mesh: _spec_impl(deep, wide),
         synthetic_batch=lambda rng, bs: synthetic_batch(rng, bs, sparse_dim),
         label_keys=("label",),
+        # serving entrypoint: click logit (pre-sigmoid), ref's saved
+        # inference program (`ctr/train.py:169-180`)
+        predict=lambda params, batch, mesh: _forward_impl(
+            params, batch["dense"], batch["sparse"], mesh, deep, wide
+        ),
     )
 
 

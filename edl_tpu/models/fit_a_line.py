@@ -49,6 +49,11 @@ def synthetic_batch(rng: np.random.Generator, batch_size: int) -> dict:
     return {"x": x, "y": y.astype(np.float32)}
 
 
+def predict(params: dict, batch: dict, mesh) -> jax.Array:
+    """(B, 13) features -> (B, 1) predicted price (serving entrypoint)."""
+    return batch["x"] @ params["w"] + params["b"]
+
+
 MODEL = Model(
     name="fit_a_line",
     init=init,
@@ -56,4 +61,5 @@ MODEL = Model(
     param_spec=param_spec,
     synthetic_batch=synthetic_batch,
     label_keys=("y",),
+    predict=predict,
 )

@@ -113,4 +113,5 @@ MODEL = Model(
     param_spec=param_spec,
     synthetic_batch=synthetic_batch,
     label_keys=("label",),
+    predict=lambda params, batch, mesh: apply(params, batch["image"]),
 )

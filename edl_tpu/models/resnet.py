@@ -230,7 +230,7 @@ def _synthetic_batch(cfg: ResNetConfig, rng: np.random.Generator,
 
 
 def accuracy(model: Model, params: dict, batch: dict) -> jax.Array:
-    cfg = model.config  # type: ignore[attr-defined]
+    cfg = model.config
     logits = _apply(cfg, params, jnp.asarray(batch["image"]))
     return jnp.mean(
         (jnp.argmax(logits, axis=-1) == jnp.asarray(batch["label"])).astype(
@@ -241,22 +241,21 @@ def accuracy(model: Model, params: dict, batch: dict) -> jax.Array:
 
 def make_model(cfg: ResNetConfig | None = None, **overrides) -> Model:
     cfg = cfg or ResNetConfig(**overrides)
-    model = Model(
+    return Model(
         name=f"resnet{cfg.depth}",
         init=partial(_init, cfg),
         loss_fn=partial(_loss, cfg),
         param_spec=partial(_param_spec, cfg),
         synthetic_batch=partial(_synthetic_batch, cfg),
         label_keys=("label",),
+        predict=lambda params, batch, mesh: _apply(cfg, params, batch["image"]),
+        config=cfg,
     )
-    # Stash the config for forward/accuracy helpers and inference export.
-    object.__setattr__(model, "config", cfg)
-    return model
 
 
 def forward(model: Model, params: dict, images) -> jax.Array:
     """Inference entrypoint: logits for (B, S, S, 3) float32 images."""
-    return _apply(model.config, params, jnp.asarray(images))  # type: ignore[attr-defined]
+    return _apply(model.config, params, jnp.asarray(images))
 
 
 #: ResNet-50 / ImageNet — the BASELINE.json configuration.

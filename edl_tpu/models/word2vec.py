@@ -50,7 +50,9 @@ def init(key: jax.Array, mesh) -> dict:
     }
 
 
-def loss_fn(params: dict, batch: dict, mesh) -> jax.Array:
+def predict(params: dict, batch: dict, mesh) -> jax.Array:
+    """Context ids (B, 4) -> next-word logits (B, VOCAB) — the serving
+    entrypoint; loss_fn is cross-entropy over the same forward."""
     ctx = _table.apply(mesh, params["table"], batch["context"])  # (B, 4, D)
     h = ctx.reshape(ctx.shape[0], -1).astype(jnp.bfloat16)
     h = jax.nn.relu(
@@ -58,7 +60,11 @@ def loss_fn(params: dict, batch: dict, mesh) -> jax.Array:
         + params["hidden"]["b"].astype(jnp.bfloat16)
     )
     logits = jnp.dot(h, params["out"]["w"].astype(jnp.bfloat16)).astype(jnp.float32)
-    logits = logits + params["out"]["b"]
+    return logits + params["out"]["b"]
+
+
+def loss_fn(params: dict, batch: dict, mesh) -> jax.Array:
+    logits = predict(params, batch, mesh)
     labels = jax.nn.one_hot(batch["target"], VOCAB, dtype=jnp.float32)
     return -jnp.mean(jnp.sum(labels * jax.nn.log_softmax(logits), axis=-1))
 
@@ -84,4 +90,5 @@ MODEL = Model(
     param_spec=param_spec,
     synthetic_batch=synthetic_batch,
     label_keys=("target",),
+    predict=predict,
 )

@@ -20,6 +20,12 @@ from edl_tpu.runtime.data import (
 )
 from edl_tpu.runtime.distributed import DistributedIdentity, distributed_init
 from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
+from edl_tpu.runtime.export import (
+    InferenceModel,
+    PeriodicExporter,
+    load_inference_model,
+    save_inference_model,
+)
 from edl_tpu.runtime.multihost import MultiHostWorker
 from edl_tpu.runtime.wire import KVCodecChannel, WireCodec, WireRestartRequired
 
@@ -29,7 +35,9 @@ __all__ = [
     "ElasticConfig",
     "ElasticWorker",
     "FileShardSource",
+    "InferenceModel",
     "KVCodecChannel",
+    "PeriodicExporter",
     "LeaseReader",
     "MultiHostWorker",
     "RescaleEvent",
@@ -42,6 +50,8 @@ __all__ = [
     "abstract_like",
     "distributed_init",
     "live_state_specs",
+    "load_inference_model",
+    "save_inference_model",
     "pass_task",
     "pass_tasks",
     "shard_names",

@@ -51,6 +51,10 @@ class ElasticConfig:
     #: (the checkpoint is already durable, latecomers restore from it).
     rescale_barrier_timeout: float = 60.0
     batch_axis: str = "data"
+    #: optional per-step hook (step, state) -> None — e.g. a
+    #: `runtime.export.PeriodicExporter` writing the serving artifact the
+    #: way the reference's trainer 0 does (`ctr/train.py:169-180`).
+    step_callback: Optional[Callable[[int, TrainState], None]] = None
     #: multi-host mode: on a membership change, checkpoint durably and exit
     #: the process with RESCALE_EXIT_CODE instead of rebuilding in-process.
     #: jax.distributed's world size is fixed at initialize, so a multi-host
@@ -297,6 +301,8 @@ class ElasticWorker:
                             p = split_pass(reader.current)[1]
                             self.pass_steps[p] = self.pass_steps.get(p, 0) + 1
                         step = int(state.step)
+                        if self.config.step_callback is not None:
+                            self.config.step_callback(step, state)
                         if step - last_ckpt_step >= self.config.checkpoint_interval:
                             self._checkpoint_and_commit(state, reader, block=False)
                             last_ckpt_step = step

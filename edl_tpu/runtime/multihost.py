@@ -349,6 +349,8 @@ class MultiHostWorker:
                 self.losses.append(float(loss))
                 if self.profiler is not None:
                     self.profiler.step(len(next(iter(batch.values()))))
+                if self.config.step_callback is not None:
+                    self.config.step_callback(int(state.step), state)
 
             from edl_tpu.runtime.data import prefetch_iter
             from edl_tpu.runtime.wire import WireRestartRequired

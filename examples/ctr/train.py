@@ -71,7 +71,36 @@ def parse_args():
                              "(ref py_reader double buffering, train.py:120-129)")
     parser.add_argument("--wire-transport", action="store_true",
                         help="compact host->device batch codec (bf16/u8/u24)")
+    parser.add_argument("--export-dir", default="",
+                        help="write a serving artifact here periodically "
+                             "(ref save_inference_model, train.py:169-180)")
+    parser.add_argument("--export-interval", type=int, default=1000,
+                        help="steps between exports (ref: every 1000 batches)")
+    parser.add_argument("--infer", action="store_true",
+                        help="load the --export-dir artifact and score a "
+                             "held-out batch instead of training")
     return parser.parse_args()
+
+
+def infer(args) -> None:
+    """Serving-side half of the reference's save-then-infer flow."""
+    from edl_tpu.runtime import load_inference_model
+
+    art = load_inference_model(args.export_dir)
+    batch = art.model.synthetic_batch(np.random.default_rng(123),
+                                      args.batch_size)
+    logits = np.asarray(art.predict({k: v for k, v in batch.items()
+                                     if k != "label"}))
+    prob = 1.0 / (1.0 + np.exp(-logits))
+    # logloss against the held-out labels (the training objective)
+    y = batch["label"].astype(np.float64)
+    eps = 1e-7
+    logloss = float(np.mean(
+        -(y * np.log(prob + eps) + (1 - y) * np.log(1 - prob + eps))
+    ))
+    print(json.dumps({"step": art.step, "examples": int(logits.shape[0]),
+                      "mean_ctr": round(float(prob.mean()), 4),
+                      "logloss": round(logloss, 4)}))
 
 
 def prepare(args) -> None:
@@ -100,6 +129,11 @@ def main() -> None:
         if not args.data_dir:
             raise SystemExit("--prepare requires --data-dir")
         prepare(args)
+        return
+    if args.infer:
+        if not args.export_dir:
+            raise SystemExit("--infer requires --export-dir")
+        infer(args)
         return
     ctx = LaunchContext.from_env()
     model = ctr.make_model(shard_axis=args.shard_axis,
@@ -141,10 +175,22 @@ def main() -> None:
         client = coord.client("worker-0")
         ctx.checkpoint_dir = ctx.checkpoint_dir or tempfile.mkdtemp(prefix="edl-ctr-")
 
+    exporter = None
+    if args.export_dir:
+        from edl_tpu.runtime import PeriodicExporter
+
+        # Rank 0 only, like the reference's trainer-0 duty (train.py:169-180).
+        exporter = PeriodicExporter(
+            args.export_dir, "ctr", args.export_interval,
+            config={"shard_axis": args.shard_axis,
+                    "sparse_dim": args.sparse_feature_dim},
+            rank=ident.process_id if ident is not None else 0,
+        )
     cfg = ElasticConfig(
         checkpoint_dir=ctx.checkpoint_dir,
         checkpoint_interval=ctx.checkpoint_interval,
         prefetch=args.prefetch,
+        step_callback=exporter,
         trainer=TrainerConfig(optimizer="adagrad",
                               learning_rate=args.learning_rate,
                               wire_transport=args.wire_transport),
@@ -160,6 +206,9 @@ def main() -> None:
     else:
         worker = ElasticWorker(model, client, source, cfg, mesh_axes=mesh_axes)
     metrics = worker.run()
+    if exporter is not None:
+        exporter.wait()  # surface a failed background artifact write
+        metrics["exports"] = float(exporter.exports)
     print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
 
 

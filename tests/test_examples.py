@@ -73,12 +73,36 @@ def test_lm_multi_axis_standalone():
     assert out["final_loss"] < math.log(128) + 0.5  # near-uniform start
 
 
+def test_ctr_export_then_infer(tmp_path):
+    """Reference save-then-infer flow (`ctr/train.py:169-180`): training
+    periodically writes the serving artifact; --infer loads and scores."""
+    export_dir = str(tmp_path / "serve")
+    out = run_example(
+        "examples/ctr/train.py",
+        "--batch-size", "256", "--batches-per-shard", "3",
+        "--sparse-feature-dim", "4096",
+        "--export-dir", export_dir, "--export-interval", "4",
+        timeout=420,
+    )
+    assert out["steps"] == 12.0  # 4 shards x 3 batches
+    inf = run_example(
+        "examples/ctr/train.py", "--infer",
+        "--batch-size", "256", "--sparse-feature-dim", "4096",
+        "--export-dir", export_dir,
+    )
+    assert inf["step"] == 12
+    assert inf["examples"] == 256
+    assert 0.0 < inf["mean_ctr"] < 1.0
+    assert inf["logloss"] < 0.69  # better than ln 2 coin-flip
+
+
 @pytest.mark.parametrize("yaml_path", [
     "examples/fit_a_line/job.yaml",
     "examples/ctr/job.yaml",
     "examples/word2vec/job.yaml",
     "examples/mnist/job.yaml",
     "examples/lm/job.yaml",
+    "examples/resnet/job.yaml",
 ])
 def test_job_yamls_pass_admission(yaml_path):
     env = dict(os.environ)

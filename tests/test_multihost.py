@@ -33,12 +33,23 @@ from edl_tpu.runtime.train_loop import TrainerConfig
 ctx = LaunchContext.from_env()
 client = wait_coordinator(ctx.coordinator_endpoint)
 client.worker = os.environ.get("WORKER_NAME") or os.environ["EDL_POD_NAME"]
-distributed_init(ctx, client, timeout=90.0, jax_port={jax_port})
+ident = distributed_init(ctx, client, timeout=90.0, jax_port={jax_port})
 if os.environ.get("MODEL") == "ctr_small":
     from edl_tpu.models import ctr
     model = ctr.make_model(sparse_dim=503)
+    model_ref, model_config = "ctr", {{"sparse_dim": 503}}
 else:
     model = fit_a_line.MODEL
+    model_ref, model_config = "fit_a_line", None
+exporter = None
+if os.environ.get("EXPORT_DIR"):
+    from edl_tpu.runtime import PeriodicExporter
+    exporter = PeriodicExporter(
+        os.environ["EXPORT_DIR"], model_ref,
+        int(os.environ.get("EXPORT_INTERVAL", "5")),
+        config=model_config,
+        rank=ident.process_id if ident is not None else 0,
+    )
 if os.environ.get("FILE_SHARD_ROOT"):
     source = FileShardSource(root=os.environ["FILE_SHARD_ROOT"], batch_size=16)
 else:
@@ -68,6 +79,7 @@ worker = MultiHostWorker(
         checkpoint_dir=os.environ["CKPT_DIR"],
         checkpoint_interval=int(os.environ.get("CKPT_INTERVAL", "1000")),
         rescale_barrier_timeout=30.0,
+        step_callback=exporter,
         trainer=TrainerConfig(
             optimizer="sgd", learning_rate=0.05,
             wire_transport=os.environ.get("WIRE") == "1",
@@ -76,6 +88,9 @@ worker = MultiHostWorker(
     ),
 )
 metrics = worker.run()
+if exporter is not None:
+    exporter.wait()
+    metrics["exports"] = exporter.exports
 print("METRICS " + json.dumps(metrics))
 """
 
@@ -445,3 +460,46 @@ def test_multihost_prefetch_config_trains_identically(tmp_path):
         results[tag] = (m["steps"], m["final_loss"], st["done"], st["queued"])
     assert results["sync"] == results["pre"]
     assert results["pre"][2] == 3  # all shards completed
+
+
+def test_two_process_export_gathers_sharded_tables(tmp_path):
+    """Multi-host serving export: the CTR tables are row-sharded across the
+    2-process global mesh (not fully addressable on any rank), so the
+    gather must be the collective process_allgather path; rank 0 writes an
+    artifact that then serves single-process."""
+    import numpy as np
+
+    from edl_tpu.runtime import load_inference_model
+
+    ensure_built()
+    jax_port = free_port()
+    export_dir = str(tmp_path / "serve")
+    with CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks([f"ex/part-{i:05d}" for i in range(4)])
+        extra = {"MODEL": "ctr_small", "EXPORT_DIR": export_dir,
+                 "EXPORT_INTERVAL": "3"}
+        procs = [
+            spawn_worker(f"w{i}", server, str(tmp_path / "ck"), jax_port,
+                         extra_env=extra)
+            for i in range(2)
+        ]
+        outs = [p.communicate(timeout=240) for p in procs]
+    per_rank_exports = []
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("METRICS ")][0]
+        m = json.loads(line[len("METRICS "):])
+        # 4 shards / 2 procs lockstep x 3 batches -> 6 steps on both ranks
+        assert m["steps"] == 6.0
+        per_rank_exports.append(m["exports"])
+    assert sorted(per_rank_exports) == [0, 2]  # writer rank only: steps 3, 6
+
+    art = load_inference_model(export_dir)
+    assert art.step == 6
+    assert art.config == {"sparse_dim": 503}
+    batch = art.model.synthetic_batch(np.random.default_rng(3), 32)
+    logits = np.asarray(art.predict(
+        {"dense": batch["dense"], "sparse": batch["sparse"]}
+    ))
+    assert logits.shape == (32,) and np.isfinite(logits).all()
