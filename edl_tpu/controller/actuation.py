@@ -39,10 +39,16 @@ EXPECTED_WORLD_KEY = "edl/expected_world"
 class CoordinatorActuator:
     """Dials per-job coordinators to publish rescale targets."""
 
-    def __init__(self, dial_timeout: float = 3.0):
+    def __init__(self, dial_timeout: float = 3.0, dial_backoff: float = 5.0):
         self.dial_timeout = dial_timeout
+        #: after a dial failure, skip dialing that job this long — an
+        #: unreachable coordinator (still materializing, or a DNS name that
+        #: only resolves in-cluster) must not stall every autoscaler loop
+        #: for the full dial timeout
+        self.dial_backoff = dial_backoff
         self._lock = threading.Lock()
         self._endpoints: Dict[str, Tuple[str, int]] = {}
+        self._backoff_until: Dict[str, float] = {}
 
     # -- endpoint registry -----------------------------------------------------
 
@@ -63,17 +69,33 @@ class CoordinatorActuator:
         with self._lock:
             self._endpoints.pop(job_name, None)
 
-    def _dial(self, job_name: str):
+    def _dial(self, job_name: str, force: bool = False):
+        import time
+
         with self._lock:
             endpoint = self._endpoints.get(job_name)
-        if endpoint is None:
-            return None
+            if endpoint is None:
+                return None
+            if (not force
+                    and time.monotonic() < self._backoff_until.get(job_name, 0.0)):
+                return None
         from edl_tpu.coordinator.client import CoordinatorClient
 
-        return CoordinatorClient(
-            host=endpoint[0], port=endpoint[1],
-            worker=f"controller/{job_name}", connect_timeout=self.dial_timeout,
-        )
+        try:
+            client = CoordinatorClient(
+                host=endpoint[0], port=endpoint[1],
+                worker=f"controller/{job_name}",
+                connect_timeout=self.dial_timeout,
+            )
+        except Exception:
+            with self._lock:
+                self._backoff_until[job_name] = (
+                    time.monotonic() + self.dial_backoff
+                )
+            raise
+        with self._lock:
+            self._backoff_until.pop(job_name, None)
+        return client
 
     # -- the two writes --------------------------------------------------------
 
@@ -108,3 +130,41 @@ class CoordinatorActuator:
         except Exception as e:
             log.debug("nudge of %s failed: %s", job_name, e)
             return False
+
+    def publish_and_nudge(self, job_name: str, world: int) -> bool:
+        """Both writes over ONE dial — the scale-down path needs the epoch
+        moved before any pod is killed, and two sequential dial timeouts
+        against an unreachable coordinator would stall the autoscaler loop
+        twice as long for nothing.
+
+        Ignores the dial backoff (``force``): shrinks are rare and this
+        write is correctness-relevant (it dissolves the gang at a round
+        boundary before the SIGTERMs land), so it always deserves a fresh
+        dial attempt. A *still*-unreachable coordinator logs a warning —
+        the caller proceeds anyway (the controller may legitimately sit
+        outside the coordinator's network, e.g. a DNS name that only
+        resolves in-cluster; workers then fall back to termination-driven
+        membership events and poll/TTL timeouts)."""
+        try:
+            client = self._dial(job_name, force=True)
+            if client is None:
+                self._warn_unreachable(job_name, world)
+                return False
+            with client:
+                client.kv_put(EXPECTED_WORLD_KEY, str(int(world)))
+                epoch = client.bump_epoch()
+            log.info("published world=%d and nudged %s to epoch %d",
+                     world, job_name, epoch)
+            return True
+        except Exception as e:
+            self._warn_unreachable(job_name, world, e)
+            return False
+
+    def _warn_unreachable(self, job_name, world, err=None):
+        log.warning(
+            "scale-down of %s to world=%d proceeds WITHOUT the "
+            "epoch-before-SIGTERM handshake (coordinator unreachable%s); "
+            "victims that miss their graceful drain leave survivors to "
+            "recover via poll timeouts / membership TTL",
+            job_name, world, f": {err}" if err else "",
+        )

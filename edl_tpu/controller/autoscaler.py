@@ -384,12 +384,24 @@ class Autoscaler:
             for attempt in range(self.config.update_retries):
                 try:
                     before = self.cluster.get_trainer_parallelism(name)
+                    shrink = parallelism < before
                     if self.actuator is not None:
                         # Target world goes to the coordinator FIRST: a worker
-                        # (re)starting mid-actuation must already see it.
-                        self.actuator.publish_expected_world(name, parallelism)
+                        # (re)starting mid-actuation must already see it. On
+                        # scale-DOWN the epoch also moves before any pod gets
+                        # SIGTERM (one combined dial): every member then
+                        # dissolves the gang at its next round boundary via
+                        # the ordinary rescale path — killing first would
+                        # race a survivor into publishing a round whose
+                        # collectives wait on the dead peer forever.
+                        # Scale-up keeps nudge-last (the join itself is what
+                        # must not be missed).
+                        if shrink:
+                            self.actuator.publish_and_nudge(name, parallelism)
+                        else:
+                            self.actuator.publish_expected_world(name, parallelism)
                     self.cluster.set_trainer_parallelism(name, parallelism)
-                    if self.actuator is not None:
+                    if self.actuator is not None and not shrink:
                         self.actuator.nudge(name)
                     record = ScaleRecord(
                         timestamp=time.time(),

@@ -58,10 +58,7 @@ class InProcessCoordinator:
             m["rank"] = r
         self._next_rank = len(self._members)
         self._epoch += 1
-        back = [t for t, l in self._leased.items() if l["worker"] == name]
-        for t in back:
-            del self._leased[t]
-            self._todo.append(t)
+        self._requeue_worker_leases(name)
         self._release_sync()
 
     def _release_sync(self) -> None:
@@ -83,6 +80,11 @@ class InProcessCoordinator:
     def register(self, worker: str) -> Dict:
         with self._lock:
             self._tick()
+            # Incarnation boundary: leases held under this name belong to a
+            # dead predecessor (same pod name, warm-restarted); requeue them
+            # for replay — the successor's heartbeats would otherwise renew
+            # them forever and rank 0 would deadlock on its own stale leases.
+            self._requeue_worker_leases(worker)
             if worker not in self._members:
                 self._members[worker] = {
                     "rank": self._next_rank,
@@ -93,8 +95,13 @@ class InProcessCoordinator:
                 self._release_sync()
             else:
                 self._members[worker]["last_heartbeat"] = time.monotonic()
-                self._renew_leases(worker)
             return self._membership_reply(worker)
+
+    def _requeue_worker_leases(self, worker: str) -> None:
+        stale = [t for t, l in self._leased.items() if l["worker"] == worker]
+        for t in stale:
+            del self._leased[t]
+            self._todo.append(t)
 
     def _renew_leases(self, worker: str) -> None:
         """A live worker keeps its leases (etcd-keepalive semantics): renewal

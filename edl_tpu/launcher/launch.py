@@ -230,12 +230,56 @@ def start_trainer(
     env = dict(os.environ)
     env.update(extra_env or {})
     cwd = ctx.workspace or None
-    for restart in range(max_rescale_restarts + 1):
-        log.info("exec: %s (cwd=%s, restart=%d)", ctx.entry, cwd or ".", restart)
-        proc = subprocess.run(shlex.split(ctx.entry), env=env, cwd=cwd)
-        if proc.returncode != RESCALE_EXIT_CODE:
-            break
-        log.info("entry requested rescale restart (exit %d)", RESCALE_EXIT_CODE)
+    # Forward pod termination to the entry: K8s (and ProcessCluster)
+    # SIGTERM the launcher — pod PID 1. Without forwarding, the training
+    # child outlives its pod as an orphan, holding gang membership and
+    # shard leases until TTL expiry (the slow path a graceful drain
+    # exists to avoid).
+    import signal as _signal
+
+    from edl_tpu.runtime.signals import main_thread_signal
+
+    state = {"proc": None, "terminating": False}
+
+    def _forward(signum, frame):
+        state["terminating"] = True
+        p = state["proc"]
+        if p is not None and p.poll() is None:
+            p.send_signal(_signal.SIGTERM)
+
+    proc = None
+    with main_thread_signal(_signal.SIGTERM, _forward):
+        for restart in range(max_rescale_restarts + 1):
+            if state["terminating"]:
+                break  # signal landed between restarts: nothing to relaunch
+            log.info("exec: %s (cwd=%s, restart=%d)",
+                     ctx.entry, cwd or ".", restart)
+            proc = subprocess.Popen(shlex.split(ctx.entry), env=env, cwd=cwd)
+            state["proc"] = proc
+            if state["terminating"] and proc.poll() is None:
+                # Signal landed after the spawn but before the handler could
+                # see this proc: forward by hand so the fresh child drains.
+                proc.send_signal(_signal.SIGTERM)
+            proc.wait()
+            if proc.returncode != RESCALE_EXIT_CODE or state["terminating"]:
+                break
+            log.info("entry requested rescale restart (exit %d)",
+                     RESCALE_EXIT_CODE)
+    if proc is None:  # terminated before the first spawn
+        _write_termination_log(ctx, "terminated before entry launch")
+        client.close()
+        return 0
+    if state["terminating"] and proc.returncode in (RESCALE_EXIT_CODE,
+                                                    -_signal.SIGTERM):
+        # Pod deletion, not a crash: the entry either drained (rescale
+        # exit) or died to the forwarded SIGTERM before its drain handler
+        # was up (interpreter startup / first jit). Neither may burn the
+        # job-wide failure budget — repeated clean scale-downs would brick
+        # the job against check_failed_count.
+        reason = "terminated by pod deletion"
+        _write_termination_log(ctx, reason)
+        client.close()
+        return 0
     reason = map_exit_code(proc.returncode)
     _write_termination_log(ctx, reason)
     if proc.returncode != 0:

@@ -146,9 +146,17 @@ def _write_artifact(directory, model_ref, host_flat, config, step) -> None:
             )
         arrays[f"leaf_{i:05d}"] = arr
         leaves.append({"path": _encode_path(path), "dtype": logical})
-    # Step-unique weights published BEFORE the manifest that names them: a
-    # reader pairing manifest -> weights can never mix two exports.
-    weights_name = f"params-{step if step is not None else 'final'}.npz"
+    # Unique weights name published BEFORE the manifest that names it: a
+    # reader pairing manifest -> weights can never mix two exports. A
+    # step-less save gets a random suffix (uniqueness is the invariant;
+    # only ordering needs steps, and the regression guard above already
+    # treats step-less saves as unordered).
+    if step is not None:
+        weights_name = f"params-{step}.npz"
+    else:
+        import uuid
+
+        weights_name = f"params-final-{uuid.uuid4().hex[:8]}.npz"
     manifest = {
         "format": _FORMAT,
         "model": model_ref,

@@ -275,7 +275,44 @@ class MultiHostWorker:
 
     # -- main loop -------------------------------------------------------------
 
+    def _graceful_leave(self) -> None:
+        """Pod-termination drain (scale-down / preemption): requeue the
+        trained-but-uncovered shards immediately (their checkpoint never
+        landed — TTL expiry would replay them anyway, just minutes later),
+        deregister so the epoch bumps for survivors NOW, and exit 0.
+        The reference's analog is free: trainer death just stops gradient
+        pushes and the master re-leases its tasks; an SPMD gang must leave
+        at a round boundary so no peer is abandoned mid-collective."""
+        log.info("SIGTERM drain: requeueing %d uncovered shards, leaving",
+                 len(self._uncommitted))
+        for task in self._uncommitted:
+            try:
+                self.client.fail_task(task)
+            except Exception:  # noqa: BLE001 — leaving anyway; TTL covers it
+                break
+        self._uncommitted.clear()
+        try:
+            self.client.leave()
+        except Exception:  # noqa: BLE001
+            pass
+        raise SystemExit(0)
+
     def run(self, max_rounds: int = 1_000_000) -> Dict[str, float]:
+        import signal
+
+        from edl_tpu.runtime.signals import main_thread_signal
+
+        self._drain_requested = False
+
+        def _on_term(signum, frame):
+            self._drain_requested = True
+
+        # SIGTERM -> drain at the next round boundary (no-op install off
+        # the main thread — pytest drives workers from threads too).
+        with main_thread_signal(signal.SIGTERM, _on_term):
+            return self._run(max_rounds)
+
+    def _run(self, max_rounds: int) -> Dict[str, float]:
         rank = jax.process_index()
         world = jax.process_count()
         info = self.client.register()
@@ -313,6 +350,10 @@ class MultiHostWorker:
         if self.profiler is not None:
             self.profiler.start()
         for rnd in range(max_rounds):
+            if self._drain_requested:
+                # Round boundary: no collective in flight on any peer that
+                # this rank could abandon — safe to go.
+                self._graceful_leave()
             if rank == 0:
                 msg = self._publish_round(epoch, rnd, world)
             else:

@@ -399,6 +399,24 @@ class Coordinator {
   // (shards completed only after a covering checkpoint) can outlive the
   // lease TTL without healthy runs retraining shards. Expiry then fires
   // only for workers whose HEARTBEAT also stopped — real failures.
+  // Register is an incarnation boundary: any leases still held under the
+  // registering worker's name belong to a dead predecessor (same pod name,
+  // warm-restarted), and its uncovered shards must replay. Without this,
+  // the successor's heartbeats renew its predecessor's leases forever and
+  // rank 0 deadlocks waiting for "another worker's" leases to expire —
+  // they are its own. (No durability record: leases are requeued on
+  // restart anyway, see the snapshot format note.)
+  void requeue_worker_leases(const std::string& worker) {
+    std::vector<std::string> back;
+    for (auto& [task, lease] : leased_)
+      if (lease.worker == worker) back.push_back(task);
+    for (auto& t : back) {
+      leased_.erase(t);
+      todo_.push_back(t);
+      todo_set_.insert(t);
+    }
+  }
+
   void renew_leases(const std::string& worker) {
     double deadline = now_sec() + task_lease_sec_;
     for (auto& [_, lease] : leased_)
@@ -649,14 +667,7 @@ void Coordinator::drop_member(const std::string& name) {
     bump_epoch();
     // Requeue this worker's leases immediately: a departed trainer's chunk
     // goes back to the queue (master semantics on task timeout).
-    std::vector<std::string> back;
-    for (auto& [task, lease] : leased_)
-      if (lease.worker == name) back.push_back(task);
-    for (auto& t : back) {
-      leased_.erase(t);
-      todo_.push_back(t);
-      todo_set_.insert(t);
-    }
+    requeue_worker_leases(name);
     release_sync(false);
   }
 }
@@ -701,6 +712,7 @@ std::string Coordinator::membership_reply(const std::string& worker, bool ok) {
 std::string Coordinator::op_register(const JsonObject& req) {
   std::string worker = get_str(req, "worker");
   if (worker.empty()) return JsonWriter().field("ok", false).field("error", "worker required").done();
+  requeue_worker_leases(worker);  // incarnation boundary: replay uncovered
   auto it = members_.find(worker);
   if (it == members_.end()) {
     members_[worker] = Member{next_rank_++, now_sec()};
@@ -708,7 +720,6 @@ std::string Coordinator::op_register(const JsonObject& req) {
     release_sync(false);
   } else {
     it->second.last_heartbeat = now_sec();  // re-register == refresh
-    renew_leases(worker);
   }
   return membership_reply(worker, true);
 }

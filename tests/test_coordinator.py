@@ -554,6 +554,29 @@ def test_heartbeat_renews_leases(coord):
     a.leave()
 
 
+def test_register_requeues_predecessors_leases(coord):
+    """Register is an incarnation boundary: a warm-restarted worker (same
+    pod name) must get its dead predecessor's leases REQUEUED, not renewed.
+    Renewal-on-register let the successor's heartbeats keep stale leases
+    alive forever — rank 0 then deadlocked in 'stop: wait' rounds on leases
+    that were its own (caught live by the multi-job scale-down e2e)."""
+    a = coord.client("podA")
+    a.register()
+    a.add_tasks(["inc0", "inc1", "inc2"])
+    assert a.acquire_task() is not None
+    assert a.acquire_task() is not None
+    st = a.status()
+    assert int(st["leased"]) == 2 and int(st["queued"]) == 1, st
+    # the pod warm-restarts: a fresh incarnation registers under the name
+    a.register()
+    st = a.status()
+    assert int(st["leased"]) == 0 and int(st["queued"]) == 3, st
+    # and can lease everything back itself (no double-lease residue)
+    got = {a.acquire_task() for _ in range(3)}
+    assert got == {"inc0", "inc1", "inc2"}
+    a.leave()
+
+
 def test_native_durability_random_ops_survive_kill(tmp_path):
     """Property test for the delta log: after ANY sequence of acked mutations
     and a kill -9 at an arbitrary point, a restart restores exactly the acked

@@ -154,6 +154,22 @@ def test_replayed_steps_never_regress_published_artifact(tmp_path):
     assert load_inference_model(d, mesh=mesh).step == 12
 
 
+def test_stepless_saves_stay_unique(tmp_path):
+    """step=None saves must still give each export its own weights file —
+    a poller holding the first manifest must never read the second save's
+    bytes through it."""
+    mesh = single_mesh()
+    params = fit_a_line.MODEL.init(jax.random.PRNGKey(0), mesh)
+    d = str(tmp_path / "serve")
+    save_inference_model(d, "fit_a_line", params)
+    first = json.load(open(os.path.join(d, "manifest.json")))["weights"]
+    save_inference_model(d, "fit_a_line", params)
+    second = json.load(open(os.path.join(d, "manifest.json")))["weights"]
+    assert first != second
+    assert os.path.exists(os.path.join(d, first))  # grace generation kept
+    assert load_inference_model(d, mesh=mesh).step is None
+
+
 def test_elastic_worker_exports_during_training(tmp_path):
     """The integration the reference has: training periodically publishes a
     servable artifact; a loader scores with it mid/post-run."""

@@ -38,6 +38,12 @@ if os.environ.get("MODEL") == "ctr_small":
     from edl_tpu.models import ctr
     model = ctr.make_model(sparse_dim=503)
     model_ref, model_config = "ctr", {{"sparse_dim": 503}}
+elif os.environ.get("MODEL") == "resnet_tiny":
+    import dataclasses
+    from edl_tpu.models import resnet
+    model = resnet.make_model(resnet.TINY)
+    # exports must rebuild TINY, not the default ResNet-50
+    model_ref, model_config = "resnet", dataclasses.asdict(resnet.TINY)
 else:
     model = fit_a_line.MODEL
     model_ref, model_config = "fit_a_line", None

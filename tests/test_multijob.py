@@ -1,0 +1,196 @@
+"""Multi-job cluster: CTR + ResNet concurrent under one autoscaler.
+
+The driver brief's cluster configuration (`BASELINE.json` configs: "Multi-job
+cluster: CTR + ResNet concurrent (autoscaler global-util fairness)"), run
+with REAL training processes: a CTR job fills the cluster, a ResNet job
+arrives with nowhere to go, and the autoscaler's make-room pass (ref
+`pkg/autoscaler.go:406-422`; narrative `doc/boss_tutorial.md:289-301`)
+shrinks the running job so the newcomer trains instead of starving —
+shrink-to-admit fairness over first-come-takes-all.
+"""
+
+import json
+import sys
+import time
+
+import pytest
+
+from edl_tpu.api.quantity import ResourceList
+from edl_tpu.controller.actuation import EXPECTED_WORLD_KEY, CoordinatorActuator
+from edl_tpu.controller.autoscaler import Autoscaler, AutoscalerConfig
+from edl_tpu.controller.cluster import NodeInfo
+from edl_tpu.controller.jobparser import parse_to_trainer
+from edl_tpu.controller.process_cluster import ProcessCluster
+from edl_tpu.api.types import TrainingJob
+from edl_tpu.api.validation import normalize
+from edl_tpu.coordinator import CoordinatorServer
+from edl_tpu.coordinator.server import ensure_built, free_port
+
+from tests.test_actuation import LAUNCHER_SRC
+from tests.test_multihost import REPO, WORKER_SRC
+
+
+def _job(name, min_i, max_i, launcher, server, entry, ckpt, extra_env=None):
+    env = {
+        "EDL_COORDINATOR_ENDPOINT": server.address,
+        "EDL_ENTRY": f"{sys.executable} {entry}",
+        "CKPT_DIR": ckpt,
+        "CKPT_INTERVAL": "60",
+        "PYTHONUNBUFFERED": "1",  # pod logs must survive a hang diagnosis
+        **(extra_env or {}),
+    }
+    return normalize(TrainingJob.from_dict({
+        "metadata": {"name": name},
+        "spec": {
+            "fault_tolerant": True,
+            "tpu": {"chips_per_trainer": 4},
+            "trainer": {
+                "min_instance": min_i,
+                "max_instance": max_i,
+                "entrypoint": f"{sys.executable} {launcher}",
+                "resources": {"requests": {"cpu": 1}},
+                "env": env,
+            },
+        },
+    }))
+
+
+def test_ctr_and_resnet_share_cluster_fairly(tmp_path):
+    """CTR at world 2 fills both hosts; a ResNet job lands Pending; the
+    autoscaler shrinks CTR 2->1 (make-room), the freed chips place ResNet,
+    and BOTH queues drain to completion — global-utilization fairness with
+    two different real model families training concurrently."""
+    ensure_built()
+    launcher_py = tmp_path / "launcher.py"
+    launcher_py.write_text(LAUNCHER_SRC.format(repo=REPO))
+
+    ports = {"ctr": free_port(), "resnet": free_port()}
+    entries = {}
+    for tag in ("ctr", "resnet"):
+        p = tmp_path / f"entry_{tag}.py"
+        p.write_text(WORKER_SRC.format(repo=REPO, jax_port=ports[tag]))
+        entries[tag] = p
+
+    scale_records = []
+    # Generous TTLs: first-jit compile stalls on one CPU core.
+    with CoordinatorServer(task_lease_sec=120.0, heartbeat_ttl_sec=60.0) \
+            as ctr_server, \
+            CoordinatorServer(task_lease_sec=120.0, heartbeat_ttl_sec=60.0) \
+            as rn_server:
+        ctr_admin = ctr_server.client("admin")
+        # Paced so the CTR job is mid-queue when the shrink lands, and world
+        # 1 still drains the rest inside the test budget.
+        ctr_admin.add_tasks([f"ctr/part-{i:05d}" for i in range(30)])
+        rn_admin = rn_server.client("admin")
+        rn_admin.add_tasks([f"rn/part-{i:05d}" for i in range(2)])
+
+        ctr_job = _job(
+            "ctrjob", 1, 2, launcher_py, ctr_server, entries["ctr"],
+            str(tmp_path / "ck-ctr"),
+            extra_env={"MODEL": "ctr_small", "BATCHES_PER_SHARD": "6",
+                       "BATCH_SLEEP": "0.05",
+                       "EDL_TERMINATION_LOG": str(tmp_path / "term-ctr")},
+        )
+        # min == max: not elastic, so never a shrink victim — but its
+        # pending pod is exactly what triggers make-room on the CTR job.
+        rn_job = _job(
+            "rnjob", 1, 1, launcher_py, rn_server, entries["resnet"],
+            str(tmp_path / "ck-rn"),
+            extra_env={"MODEL": "resnet_tiny", "BATCHES_PER_SHARD": "2",
+                       "EDL_TERMINATION_LOG": str(tmp_path / "term-rn")},
+        )
+
+        # 2 hosts x 4 chips: capacity for exactly 2 trainers at 4 chips.
+        cluster = ProcessCluster(
+            [NodeInfo(name=f"h{i}",
+                      allocatable=ResourceList.make({"cpu": 16, "tpu": 4}))
+             for i in range(2)],
+            log_dir=str(tmp_path / "logs"),
+        )
+        try:
+            ctr_trainer = parse_to_trainer(ctr_job)
+            cluster.create_role("ctrjob", "trainer", 2, ctr_trainer.requests,
+                                ctr_trainer.limits, workload=ctr_trainer)
+
+            # real progress at world 2 before the contender shows up
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if int(ctr_admin.status().get("done", 0)) >= 2:
+                    break
+                time.sleep(0.5)
+            else:
+                pytest.fail("CTR job never made progress at world 2")
+
+            # ResNet arrives: no chips free -> its pod stays Pending.
+            rn_trainer = parse_to_trainer(rn_job)
+            cluster.create_role("rnjob", "trainer", 1, rn_trainer.requests,
+                                rn_trainer.limits, workload=rn_trainer)
+            assert [p.phase for p in cluster.job_pods("rnjob", "trainer")] \
+                == ["Pending"]
+
+            actuator = CoordinatorActuator()
+            actuator.set_endpoint("ctrjob", "127.0.0.1", ctr_server.port)
+            actuator.set_endpoint("rnjob", "127.0.0.1", rn_server.port)
+            scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.5))
+            scaler.actuator = actuator
+            scaler.on_scaled = lambda name, rec: scale_records.append((name, rec))
+            scaler.on_add(ctr_job)
+            scaler.on_add(rn_job)
+            scaler.start()
+            try:
+                deadline = time.time() + 90
+                while time.time() < deadline:
+                    pods = cluster.job_pods("rnjob", "trainer")
+                    if pods and all(p.phase == "Running" for p in pods):
+                        break
+                    time.sleep(0.3)
+                else:
+                    pytest.fail(
+                        f"ResNet pod never placed; records={scale_records}"
+                    )
+            finally:
+                scaler.stop()
+
+            # the decision was the make-room shrink of the elastic CTR job
+            assert any(
+                name == "ctrjob"
+                and (rec.from_replicas, rec.to_replicas) == (2, 1)
+                and rec.reason == "make-room"
+                for name, rec in scale_records
+            ), scale_records
+            assert ctr_admin.kv_get(EXPECTED_WORLD_KEY) == "1"
+
+            # both jobs drain to completion, concurrently
+            try:
+                cluster.wait_all(timeout=420)
+            except Exception:
+                pods = [(p.info.name, p.info.phase) for p in cluster.pods]
+                pytest.fail(
+                    f"jobs never drained: ctr={ctr_admin.status()} "
+                    f"rn={rn_admin.status()} pods={pods} "
+                    f"records={scale_records}"
+                )
+            assert all(p.phase == "Succeeded"
+                       for p in cluster.job_pods("rnjob", "trainer"))
+            ctr_pods = cluster.job_pods("ctrjob", "trainer")
+            assert len(ctr_pods) == 1  # the post-shrink survivor
+            assert ctr_pods[0].phase == "Succeeded"
+            ctr_st = ctr_admin.status()
+            rn_st = rn_admin.status()
+            assert int(ctr_st["queued"]) == 0 and int(ctr_st["leased"]) == 0
+            assert int(rn_st["queued"]) == 0 and int(rn_st["leased"]) == 0
+        finally:
+            cluster.shutdown()
+
+    # final incarnations: CTR survivor reports world 1; ResNet world 1
+    finals = {}
+    for log_file in (tmp_path / "logs").iterdir():
+        lines = [l for l in log_file.read_text().splitlines()
+                 if l.startswith("METRICS ")]
+        if lines:
+            finals[log_file.name] = json.loads(lines[-1][len("METRICS "):])
+    ctr_finals = [m for n, m in finals.items() if n.startswith("ctrjob")]
+    rn_finals = [m for n, m in finals.items() if n.startswith("rnjob")]
+    assert any(m["world"] == 1.0 and m["steps"] > 0 for m in ctr_finals)
+    assert len(rn_finals) == 1 and rn_finals[0]["world"] == 1.0
+    assert rn_finals[0]["steps"] == 4.0  # 2 shards x 2 batches
