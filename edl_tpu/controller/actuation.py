@@ -68,6 +68,8 @@ class CoordinatorActuator:
     def forget(self, job_name: str) -> None:
         with self._lock:
             self._endpoints.pop(job_name, None)
+            # a re-created same-name job must not inherit this backoff
+            self._backoff_until.pop(job_name, None)
 
     def _dial(self, job_name: str, force: bool = False):
         import time

@@ -96,8 +96,13 @@ class CoordinatorClient:
 
     # -- membership ------------------------------------------------------------
 
-    def register(self) -> Dict:
-        return self.call("register")
+    def register(self, takeover: bool = False) -> Dict:
+        """Join (or refresh) membership. ``takeover=True`` marks an
+        incarnation boundary — a fresh process claiming this worker name —
+        and requeues any leases a dead predecessor still holds; a plain
+        refresh renews them instead (a live worker re-registering mid-run
+        must not forfeit shards it is training)."""
+        return self.call("register", **({"takeover": 1} if takeover else {}))
 
     def heartbeat(self) -> Dict:
         return self.call("heartbeat")

@@ -77,14 +77,18 @@ class InProcessCoordinator:
 
     # -- ops (mirror the C++ op_* handlers) -----------------------------------
 
-    def register(self, worker: str) -> Dict:
+    def register(self, worker: str, takeover: bool = False) -> Dict:
         with self._lock:
             self._tick()
-            # Incarnation boundary: leases held under this name belong to a
-            # dead predecessor (same pod name, warm-restarted); requeue them
-            # for replay — the successor's heartbeats would otherwise renew
-            # them forever and rank 0 would deadlock on its own stale leases.
-            self._requeue_worker_leases(worker)
+            if takeover:
+                # Incarnation boundary: leases held under this name belong
+                # to a dead predecessor (same pod name, warm-restarted);
+                # requeue them for replay — the successor's heartbeats would
+                # otherwise renew them forever and rank 0 would deadlock on
+                # its own stale leases. A plain refresh (takeover=False)
+                # renews instead: a live mid-run re-register must not
+                # forfeit shards it is training.
+                self._requeue_worker_leases(worker)
             if worker not in self._members:
                 self._members[worker] = {
                     "rank": self._next_rank,
@@ -95,6 +99,7 @@ class InProcessCoordinator:
                 self._release_sync()
             else:
                 self._members[worker]["last_heartbeat"] = time.monotonic()
+                self._renew_leases(worker)
             return self._membership_reply(worker)
 
     def _requeue_worker_leases(self, worker: str) -> None:
@@ -317,8 +322,8 @@ class InProcessClient:
     def __exit__(self, *exc):
         pass
 
-    def register(self):
-        return self._c.register(self.worker)
+    def register(self, takeover: bool = False):
+        return self._c.register(self.worker, takeover=takeover)
 
     def heartbeat(self):
         return self._c.heartbeat(self.worker)

@@ -68,6 +68,12 @@ class TransformerConfig:
     #: per stage — the standard HBM-for-FLOPs trade that makes long-context
     #: training fit (scaling-book recipe; the reference has no analog).
     remat: bool = False
+    #: Pallas flash-attention kernel for the unsharded-sequence case
+    #: (`edl_tpu.ops.flash_attention`): blockwise online softmax in VMEM,
+    #: no (S, S) score materialization. Interpret mode on CPU. The
+    #: seq-sharded ring path keeps its einsum block engine (hop merge
+    #: carries m/num/den explicitly).
+    flash: bool = True
 
     @property
     def head_dim(self) -> int:
@@ -184,7 +190,7 @@ def _block(cfg: TransformerConfig, mesh: Mesh, n_sp: int, x: jax.Array, bp: dict
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     attn = _ring_attention_local(
         q, k, v, seq_axis=cfg.seq_axis, n_shards=n_sp, causal=True,
-        scale=1.0 / math.sqrt(Dh),
+        scale=1.0 / math.sqrt(Dh), flash=cfg.flash,
     )  # (Bl, Sl, Hl, Dh)
     out = jnp.einsum("bshe,hed->bsd", attn, bp["wo"].astype(jnp.bfloat16))
     out = _maybe_psum(out.astype(jnp.float32), mesh, cfg.tp_axis) + bp["bo"]

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The compute path is XLA-compiled JAX; these kernels take over exactly where
+XLA's automatic fusion cannot help — currently blockwise-online attention
+(`flash_attention`), which avoids materializing the (S, S) score matrix
+that the plain einsum+softmax attention pays.
+"""
+
+from edl_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,401 @@
+"""Flash attention: a Pallas TPU kernel for blockwise-online attention.
+
+The transformer's hot op. The plain path (`parallel.ring_attention.
+dense_attention`) materializes the (S, S) score matrix per head — O(S^2)
+HBM traffic and memory; this kernel streams K/V blocks through VMEM with
+the online-softmax recurrence (running max / numerator / denominator), so
+scores never leave on-chip memory and the sequence-length memory cost is
+O(S) per head. The matmuls hit the MXU with f32 accumulation
+(``preferred_element_type``); the elementwise recurrence rides the VPU.
+
+Causality uses GLOBAL positions (``q_offset`` / ``k_offset``), so the ring
+layer can hand the kernel any (query block, key block) pair with the same
+masking semantics as `_ring_attention_local`'s compare — the kernel is the
+within-block engine; `ppermute` stays the between-device engine.
+
+Backward is the standard two-kernel flash recipe: forward also emits the
+per-row logsumexp ``L = m + log(den)``; backward recomputes ``P = exp(S -
+L)`` blockwise (never storing it) with ``delta = rowsum(dO * O)`` folded
+in: dS = P * (dP - delta) * scale, dQ = dS K, dK = dS^T Q, dV = P^T dO.
+
+Shapes follow the models' convention: q/k/v are (B, S, H, D). Unaligned
+sequence lengths pad up to the block size: padded KEY rows are masked by a
+valid-length compare; padded QUERY rows produce unobserved garbage and are
+sliced away.
+
+On CPU (tests, the virtual-device mesh) the kernels run in Pallas
+interpret mode automatically — the same program, executed by the
+interpreter, so the CPU test suite validates exactly what the TPU runs.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+#: finite "masked" score: exp() is exactly 0.0 without nan risk
+_NEG_INF = -1e30
+
+#: default VMEM tile extents (MXU-aligned)
+_BLK_Q = 128
+_BLK_K = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _pad_seq(x: jax.Array, mult: int) -> jax.Array:
+    pad = (-x.shape[1]) % mult
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+
+
+def _positions(start, shape, dim):
+    return start + jax.lax.broadcasted_iota(jnp.int32, shape, dim)
+
+
+# -- forward -------------------------------------------------------------------
+
+
+def _fwd_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref,
+                o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, scale, causal, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qo_ref[0] + qi * blk_q  # global position of this block's row 0
+    k_start = ko_ref[0] + ki * blk_k
+
+    # Skip K blocks entirely in this Q block's causal future.
+    live = (not causal) or (k_start <= q_start + (blk_q - 1))
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0]  # (blk_q, D)
+        k = k_ref[0]  # (blk_k, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (blk_q, blk_k)
+
+        k_pos = _positions(k_start, (blk_q, blk_k), 1)
+        valid = k_pos - ko_ref[0] < kl_ref[0]  # mask padded key rows
+        if causal:
+            q_pos = _positions(q_start, (blk_q, blk_k), 0)
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]  # (blk_q, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # Mask p EXPLICITLY: a fully-masked row has every s at the _NEG_INF
+        # sentinel and m_new lands there too, so exp(s - m_new) would be 1,
+        # not 0 (reachable through ring offsets where a live block still
+        # masks some rows entirely).
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # (blk_q, blk_k) f32
+        l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        l = l_ref[:, :1]
+        # fully-masked (padded) query rows: den 0 -> emit 0, lse -inf
+        safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[...] / safe).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            l[:, 0] > 0, m_ref[:, 0] + jnp.log(safe[:, 0]), _NEG_INF
+        )
+
+
+def _fwd(q3, k3, v3, qo, ko, kl, *, scale, causal, blk_q, blk_k):
+    """q3: (BH, Sq, D); k3/v3: (BH, Sk, D) -> (o3, lse (BH, Sq) f32)."""
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k
+    )
+    scalar = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, Sq // blk_q, Sk // blk_k),
+        in_specs=[
+            scalar, scalar, scalar,
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running max m
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # running denominator l
+            pltpu.VMEM((blk_q, D), jnp.float32),  # output accumulator
+        ],
+        interpret=_interpret(),
+    )(qo, ko, kl, q3, k3, v3)
+
+
+# -- backward ------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, acc_ref,
+                   *, scale, causal, blk_q, blk_k):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = qo_ref[0] + qi * blk_q
+    k_start = ko_ref[0] + ki * blk_k
+    live = (not causal) or (k_start <= q_start + (blk_q - 1))
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)  # (blk_q, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = _positions(k_start, (blk_q, blk_k), 1)
+        valid = k_pos - ko_ref[0] < kl_ref[0]
+        if causal:
+            q_pos = _positions(q_start, (blk_q, blk_k), 0)
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        # explicit mask: a fully-masked row's lse is the _NEG_INF sentinel
+        # and exp(s - lse) would be 1 there, not 0
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (blk_q, blk_k)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == n_k - 1)
+    def _emit():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, blk_q, blk_k):
+    ki, qi = pl.program_id(1), pl.program_id(2)  # note: K outer, Q inner
+    n_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = qo_ref[0] + qi * blk_q
+    k_start = ko_ref[0] + ki * blk_k
+    live = (not causal) or (k_start <= q_start + (blk_q - 1))
+
+    @pl.when(live)
+    def _block():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = _positions(k_start, (blk_q, blk_k), 1)
+        valid = k_pos - ko_ref[0] < kl_ref[0]
+        if causal:
+            q_pos = _positions(q_start, (blk_q, blk_k), 0)
+            valid = jnp.logical_and(valid, k_pos <= q_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        # explicit mask, same sentinel-collision rationale as _bwd_dq_kernel
+        p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (blk_k, D)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (blk_k, D)
+
+    @pl.when(qi == n_q - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd(q3, k3, v3, o3, lse, do3, qo, ko, kl, *, scale, causal,
+         blk_q, blk_k):
+    BH, Sq, D = q3.shape
+    Sk = k3.shape[1]
+    delta = jnp.sum(
+        do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
+    )  # (BH, Sq)
+
+    scalar = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i))
+    k_spec = pl.BlockSpec((1, blk_k, D), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(BH, Sq // blk_q, Sk // blk_k),
+        in_specs=[scalar, scalar, scalar,
+                  q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((blk_q, D), jnp.float32)],
+        interpret=_interpret(),
+    )(qo, ko, kl, q3, k3, v3, do3, lse, delta)
+
+    # K outer / Q inner: the accumulators belong to the K block.
+    q_spec_t = pl.BlockSpec((1, blk_q, D), lambda b, j, i: (b, i, 0))
+    row_spec_t = pl.BlockSpec((1, blk_q), lambda b, j, i: (b, i))
+    k_spec_t = pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          blk_q=blk_q, blk_k=blk_k),
+        grid=(BH, Sk // blk_k, Sq // blk_q),
+        in_specs=[scalar, scalar, scalar,
+                  q_spec_t, k_spec_t, k_spec_t, q_spec_t,
+                  row_spec_t, row_spec_t],
+        out_specs=[
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((blk_k, D), jnp.float32),
+            pltpu.VMEM((blk_k, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qo, ko, kl, q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# -- public entrypoint ---------------------------------------------------------
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+)
+def _flash(q3, k3, v3, offsets, kl, scale, causal, blk_q, blk_k):
+    qo, ko = offsets
+    o3, _ = _fwd(q3, k3, v3, qo, ko, kl,
+                 scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+    return o3
+
+
+def _flash_fwd(q3, k3, v3, offsets, kl, scale, causal, blk_q, blk_k):
+    qo, ko = offsets
+    o3, lse = _fwd(q3, k3, v3, qo, ko, kl,
+                   scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+    return o3, (q3, k3, v3, o3, lse, qo, ko, kl)
+
+
+def _flash_bwd(scale, causal, blk_q, blk_k, res, do3):
+    q3, k3, v3, o3, lse, qo, ko, kl = res
+    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, qo, ko, kl,
+                      scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    q_offset=0,
+    k_offset=0,
+    block_q: int = _BLK_Q,
+    block_k: int = _BLK_K,
+) -> jax.Array:
+    """Blockwise-online attention. q: (B, Sq, H, D); k/v: (B, Sk, H, D).
+
+    ``q_offset``/``k_offset`` are the GLOBAL positions of row 0 (ints or
+    traced scalars) — sequence-parallel callers pass their shard offsets
+    and causality is evaluated in global coordinates, exactly like
+    `_ring_attention_local`'s mask. Differentiable via the flash backward
+    kernels (custom VJP).
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    def round_up(n, m):
+        return ((n + m - 1) // m) * m
+
+    # Tile alignment: blk_q is a sublane extent (multiple of 8), blk_k a
+    # lane extent (multiple of 128); short sequences shrink the block and
+    # pad up to it, with padded keys masked via the valid-length compare.
+    blk_q = min(block_q, round_up(Sq, 8))
+    blk_k = min(block_k, round_up(Sk, 128))
+
+    def to3(x):  # (B, S, H, D) -> (B*H, S, D)
+        Bx, Sx, Hx, Dx = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(Bx * Hx, Sx, Dx)
+
+    q3 = _pad_seq(to3(q), blk_q)
+    k3 = _pad_seq(to3(k), blk_k)
+    v3 = _pad_seq(to3(v), blk_k)
+
+    qo = jnp.asarray([q_offset], jnp.int32)
+    ko = jnp.asarray([k_offset], jnp.int32)
+    kl = jnp.asarray([Sk], jnp.int32)  # valid key length (pre-padding)
+
+    o3 = _flash(q3, k3, v3, (qo, ko), kl, scale, causal, blk_q, blk_k)
+    o3 = o3[:, :Sq]
+    return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3).astype(q.dtype)

@@ -67,12 +67,23 @@ def _ring_attention_local(
     n_shards: int,
     causal: bool = True,
     scale: Optional[float] = None,
+    flash: bool = False,
 ) -> jax.Array:
     """Ring attention over local shards — call inside a shard_map whose manual
-    axes include ``seq_axis``. q/k/v: (B, S_local, H_local, D)."""
+    axes include ``seq_axis``. q/k/v: (B, S_local, H_local, D).
+
+    ``flash``: route the unsharded case through the Pallas blockwise kernel
+    (`edl_tpu.ops.flash_attention`) instead of the O(S^2) dense path. The
+    ring path keeps its einsum block engine for now: its hop merge carries
+    (m, num, den) explicitly, and swapping the block engine for the kernel
+    needs a differentiable-lse variant (future work noted in ops/)."""
     B, S, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if n_shards == 1:
+        if flash:
+            from edl_tpu.ops import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
         return dense_attention(q, k, v, causal=causal, scale=scale)
 
     my = jax.lax.axis_index(seq_axis)

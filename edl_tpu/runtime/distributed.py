@@ -112,7 +112,11 @@ def derive_identity(
     port = jax_port if jax_port is not None else ctx.port + JAX_COORD_PORT_OFFSET
     deadline = time.monotonic() + timeout
 
-    info = client.register()
+    # First register of this incarnation: takeover requeues any leases a
+    # dead same-name predecessor still holds; the bring-up refreshes below
+    # are plain (this process may acquire nothing until training starts,
+    # but mid-loop refreshes must never forfeit anything either way).
+    info = client.register(takeover=True)
     while True:
         if time.monotonic() > deadline:
             raise TimeoutError(

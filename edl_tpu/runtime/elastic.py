@@ -134,7 +134,9 @@ class ElasticWorker:
     # -- membership ------------------------------------------------------------
 
     def _sync_membership(self) -> None:
-        info = self.client.register()
+        # run() entry = incarnation boundary: a predecessor's leases (same
+        # pod name, relaunched after a crash) requeue for replay.
+        info = self.client.register(takeover=True)
         self._epoch = info["epoch"]
         self._world = max(1, info["world"])
 

@@ -315,7 +315,9 @@ class MultiHostWorker:
     def _run(self, max_rounds: int) -> Dict[str, float]:
         rank = jax.process_index()
         world = jax.process_count()
-        info = self.client.register()
+        # Incarnation boundary: a warm-restarted worker's predecessor may
+        # still hold leases under this pod name; requeue them for replay.
+        info = self.client.register(takeover=True)
         epoch = int(info["epoch"])
 
         mesh = self._build_mesh()

@@ -712,7 +712,11 @@ std::string Coordinator::membership_reply(const std::string& worker, bool ok) {
 std::string Coordinator::op_register(const JsonObject& req) {
   std::string worker = get_str(req, "worker");
   if (worker.empty()) return JsonWriter().field("ok", false).field("error", "worker required").done();
-  requeue_worker_leases(worker);  // incarnation boundary: replay uncovered
+  if (get_num(req, "takeover", 0) != 0) {
+    // Incarnation boundary (a fresh process claiming this name): the
+    // predecessor's uncovered shards must replay.
+    requeue_worker_leases(worker);
+  }
   auto it = members_.find(worker);
   if (it == members_.end()) {
     members_[worker] = Member{next_rank_++, now_sec()};
@@ -720,6 +724,7 @@ std::string Coordinator::op_register(const JsonObject& req) {
     release_sync(false);
   } else {
     it->second.last_heartbeat = now_sec();  // re-register == refresh
+    renew_leases(worker);
   }
   return membership_reply(worker, true);
 }

@@ -561,14 +561,19 @@ def test_register_requeues_predecessors_leases(coord):
     alive forever — rank 0 then deadlocked in 'stop: wait' rounds on leases
     that were its own (caught live by the multi-job scale-down e2e)."""
     a = coord.client("podA")
-    a.register()
+    a.register(takeover=True)
     a.add_tasks(["inc0", "inc1", "inc2"])
     assert a.acquire_task() is not None
     assert a.acquire_task() is not None
     st = a.status()
     assert int(st["leased"]) == 2 and int(st["queued"]) == 1, st
-    # the pod warm-restarts: a fresh incarnation registers under the name
+    # a plain mid-run refresh must NOT forfeit in-flight leases (elastic
+    # workers re-register after compile-stall expiry while still training)
     a.register()
+    st = a.status()
+    assert int(st["leased"]) == 2 and int(st["queued"]) == 1, st
+    # the pod warm-restarts: a fresh incarnation claims the name
+    a.register(takeover=True)
     st = a.status()
     assert int(st["leased"]) == 0 and int(st["queued"]) == 3, st
     # and can lease everything back itself (no double-lease residue)
