@@ -64,6 +64,27 @@ def _positions(start, shape, dim):
     return start + jax.lax.broadcasted_iota(jnp.int32, shape, dim)
 
 
+def _masked_scores(q, k, *, q_start, k_start, k_origin, k_len, scale,
+                   causal, blk_q, blk_k):
+    """Shared by all three kernels: f32 scores with invalid entries at the
+    ``_NEG_INF`` sentinel, plus the validity mask itself.
+
+    Callers must mask their exp() THROUGH ``valid`` (``where(valid,
+    exp(...), 0)``), never infer it back from the scores: a fully-masked
+    row's running max / lse lands exactly on the sentinel, so
+    ``exp(s - m)`` would be 1 there, not 0."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # (blk_q, blk_k)
+    k_pos = _positions(k_start, (blk_q, blk_k), 1)
+    valid = k_pos - k_origin < k_len  # mask padded key rows
+    if causal:
+        q_pos = _positions(q_start, (blk_q, blk_k), 0)
+        valid = jnp.logical_and(valid, k_pos <= q_pos)
+    return jnp.where(valid, s, _NEG_INF), valid
+
+
 # -- forward -------------------------------------------------------------------
 
 
@@ -90,26 +111,15 @@ def _fwd_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref,
         q = q_ref[0]  # (blk_q, D)
         k = k_ref[0]  # (blk_k, D)
         v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # (blk_q, blk_k)
-
-        k_pos = _positions(k_start, (blk_q, blk_k), 1)
-        valid = k_pos - ko_ref[0] < kl_ref[0]  # mask padded key rows
-        if causal:
-            q_pos = _positions(q_start, (blk_q, blk_k), 0)
-            valid = jnp.logical_and(valid, k_pos <= q_pos)
-        s = jnp.where(valid, s, _NEG_INF)
-
+        s, valid = _masked_scores(
+            q, k, q_start=q_start, k_start=k_start, k_origin=ko_ref[0],
+            k_len=kl_ref[0], scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k,
+        )
         m_prev = m_ref[:, :1]  # (blk_q, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        # Mask p EXPLICITLY: a fully-masked row has every s at the _NEG_INF
-        # sentinel and m_new lands there too, so exp(s - m_new) would be 1,
-        # not 0 (reachable through ring offsets where a live block still
-        # masks some rows entirely).
         p = jnp.where(valid, jnp.exp(s - m_new), 0.0)  # (blk_q, blk_k) f32
         l_new = l_prev * alpha + p.sum(axis=-1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
@@ -187,18 +197,11 @@ def _bwd_dq_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref, do_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)  # (blk_q, D)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        k_pos = _positions(k_start, (blk_q, blk_k), 1)
-        valid = k_pos - ko_ref[0] < kl_ref[0]
-        if causal:
-            q_pos = _positions(q_start, (blk_q, blk_k), 0)
-            valid = jnp.logical_and(valid, k_pos <= q_pos)
-        s = jnp.where(valid, s, _NEG_INF)
-        # explicit mask: a fully-masked row's lse is the _NEG_INF sentinel
-        # and exp(s - lse) would be 1 there, not 0
+        s, valid = _masked_scores(
+            q, k, q_start=q_start, k_start=k_start, k_origin=ko_ref[0],
+            k_len=kl_ref[0], scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k,
+        )
         p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -236,17 +239,11 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref, do_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale
-        k_pos = _positions(k_start, (blk_q, blk_k), 1)
-        valid = k_pos - ko_ref[0] < kl_ref[0]
-        if causal:
-            q_pos = _positions(q_start, (blk_q, blk_k), 0)
-            valid = jnp.logical_and(valid, k_pos <= q_pos)
-        s = jnp.where(valid, s, _NEG_INF)
-        # explicit mask, same sentinel-collision rationale as _bwd_dq_kernel
+        s, valid = _masked_scores(
+            q, k, q_start=q_start, k_start=k_start, k_origin=ko_ref[0],
+            k_len=kl_ref[0], scale=scale, causal=causal,
+            blk_q=blk_q, blk_k=blk_k,
+        )
         p = jnp.where(valid, jnp.exp(s - lse_ref[0][:, None]), 0.0)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),

@@ -117,6 +117,7 @@ def derive_identity(
     # are plain (this process may acquire nothing until training starts,
     # but mid-loop refreshes must never forfeit anything either way).
     info = client.register(takeover=True)
+    last_drain_check = 0.0
     while True:
         if time.monotonic() > deadline:
             raise TimeoutError(
@@ -124,6 +125,32 @@ def derive_identity(
                 f"members={len(client.members())}/{world} rank={info.get('rank')}"
             )
         if len(client.members()) < world:
+            # Late join against a FINISHED job: if the shard queue is fully
+            # drained (done work exists, nothing queued or leased) and the
+            # missing peers are gone because they completed, the expected
+            # world will never assemble — a pod scaled up in the job's last
+            # seconds must exit cleanly, not time out as a failure.
+            # Rate-limited: the condition can only become true once, and a
+            # large slowly-assembling job must not multiply coordinator
+            # load during exactly its busiest window.
+            now = time.monotonic()
+            st = {}
+            if now - last_drain_check >= 2.0:
+                last_drain_check = now
+                st = client.status()
+            if (st
+                    and int(st.get("queued", 0)) == 0
+                    and int(st.get("leased", 0)) == 0
+                    and int(st.get("done", 0)) > 0):
+                log.info(
+                    "job already drained (done=%s) while waiting for "
+                    "world=%d (members=%d); exiting with nothing to do",
+                    st.get("done"), world, len(client.members()),
+                )
+                try:
+                    client.leave()
+                finally:
+                    raise SystemExit(0)
             time.sleep(0.2)
             info = client.register()  # refresh; also re-leases our entry
             continue

@@ -399,13 +399,15 @@ class Coordinator {
   // (shards completed only after a covering checkpoint) can outlive the
   // lease TTL without healthy runs retraining shards. Expiry then fires
   // only for workers whose HEARTBEAT also stopped — real failures.
-  // Register is an incarnation boundary: any leases still held under the
-  // registering worker's name belong to a dead predecessor (same pod name,
-  // warm-restarted), and its uncovered shards must replay. Without this,
-  // the successor's heartbeats renew its predecessor's leases forever and
-  // rank 0 deadlocks waiting for "another worker's" leases to expire —
-  // they are its own. (No durability record: leases are requeued on
-  // restart anyway, see the snapshot format note.)
+  // Requeue every lease held under ``worker``. Callers: member drop
+  // (expiry/leave) and TAKEOVER registration — a fresh process claiming a
+  // pod name whose dead predecessor's uncovered shards must replay
+  // (without it, the successor's heartbeats would renew its predecessor's
+  // leases forever and rank 0 deadlocks on leases that are its own). A
+  // plain refresh register does NOT come here: a live worker
+  // re-registering mid-run keeps the shards it is training. (No
+  // durability record: leases are requeued on restart anyway, see the
+  // snapshot format note.)
   void requeue_worker_leases(const std::string& worker) {
     std::vector<std::string> back;
     for (auto& [task, lease] : leased_)

@@ -242,3 +242,23 @@ def test_elastic_worker_exits_for_restart_on_rescale(tmp_path):
     assert exc.value.code == RESCALE_EXIT_CODE
     # the pre-exit checkpoint is durable and restorable
     assert Checkpointer(str(tmp_path / "ck")).latest_step() is not None
+
+
+def test_late_joiner_exits_cleanly_when_job_drained():
+    """A pod scaled up in the job's last seconds: peers completed and left,
+    the queue is fully drained — the joiner must exit 0 ('nothing to do'),
+    not time out as a failure waiting for a world that never assembles."""
+    coord = InProcessCoordinator()
+    finisher = coord.client("w-old")
+    finisher.register()
+    finisher.add_tasks(["s0", "s1"])
+    assert finisher.acquire_task() and finisher.acquire_task()
+    finisher.complete_task("s0"), finisher.complete_task("s1")
+    finisher.leave()
+
+    joiner = coord.client("w-new")
+    with pytest.raises(SystemExit) as exc:
+        derive_identity(ctx_with(2), joiner, timeout=10.0)
+    assert exc.value.code == 0
+    st = joiner.status()
+    assert int(st["queued"]) == 0 and int(st["done"]) == 2

@@ -181,7 +181,12 @@ sys.exit(start_trainer(ctx))
         else:
             pytest.fail("world-2 phase never committed progress")
         admin.kv_put("edl/expected_world", "3")
-        p2 = spawn("w2", 3)  # registration bumps the epoch -> survivors restart
+        # Nudge like the real actuator (publish AND bump): survivors park at
+        # the world-3 rendezvous NOW instead of racing to drain the queue
+        # before the joiner's (load-dependent) interpreter startup — the
+        # one flake mode this test had under full-suite load.
+        admin.bump_epoch()
+        p2 = spawn("w2", 3)
 
         procs = (p0, p1, p2)
         outs = [p.communicate(timeout=420) for p in procs]

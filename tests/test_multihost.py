@@ -33,7 +33,10 @@ from edl_tpu.runtime.train_loop import TrainerConfig
 ctx = LaunchContext.from_env()
 client = wait_coordinator(ctx.coordinator_endpoint)
 client.worker = os.environ.get("WORKER_NAME") or os.environ["EDL_POD_NAME"]
-ident = distributed_init(ctx, client, timeout=90.0, jax_port={jax_port})
+# 180 s: bring-up races the OTHER workers' first-jit compiles for this
+# box's single core; 90 s flakes when suites run alongside (the outer
+# communicate() deadlines still bound the test).
+ident = distributed_init(ctx, client, timeout=180.0, jax_port={jax_port})
 if os.environ.get("MODEL") == "ctr_small":
     from edl_tpu.models import ctr
     model = ctr.make_model(sparse_dim=503)
