@@ -140,7 +140,8 @@ def _fwd_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref,
         )
 
 
-def _fwd(q3, k3, v3, qo, ko, kl, *, scale, causal, blk_q, blk_k):
+def _fwd(q3, k3, v3, qo, ko, kl, *, scale, causal, blk_q, blk_k,
+         out_dtype):
     """q3: (BH, Sq, D); k3/v3: (BH, Sk, D) -> (o3, lse (BH, Sq) f32)."""
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
@@ -162,7 +163,7 @@ def _fwd(q3, k3, v3, qo, ko, kl, *, scale, causal, blk_q, blk_k):
             pl.BlockSpec((1, blk_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, D), out_dtype),
             jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
         ],
         scratch_shapes=[
@@ -265,13 +266,16 @@ def _bwd_dkv_kernel(qo_ref, ko_ref, kl_ref, q_ref, k_ref, v_ref, do_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, o3, lse, do3, qo, ko, kl, *, scale, causal,
+def _bwd(q3, k3, v3, o3, lse, do3, dlse, qo, ko, kl, *, scale, causal,
          blk_q, blk_k):
     BH, Sq, D = q3.shape
     Sk = k3.shape[1]
+    # dL/ds_ij = p_ij (dp_ij - delta_i) for the out path PLUS p_ij * dlse_i
+    # for the lse path (dlse/ds = softmax row) — the lse cotangent folds
+    # into delta with a sign flip. dlse is zeros when lse wasn't consumed.
     delta = jnp.sum(
         do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1
-    )  # (BH, Sq)
+    ) - dlse.astype(jnp.float32)  # (BH, Sq)
 
     scalar = pl.BlockSpec(memory_space=pltpu.SMEM)
     q_spec = pl.BlockSpec((1, blk_q, D), lambda b, i, j: (b, i, 0))
@@ -322,25 +326,26 @@ def _bwd(q3, k3, v3, o3, lse, do3, qo, ko, kl, *, scale, causal,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
 )
-def _flash(q3, k3, v3, offsets, kl, scale, causal, blk_q, blk_k):
+def _flash(q3, k3, v3, offsets, kl, scale, causal, blk_q, blk_k, out_dtype):
     qo, ko = offsets
-    o3, _ = _fwd(q3, k3, v3, qo, ko, kl,
-                 scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
-    return o3
+    return _fwd(q3, k3, v3, qo, ko, kl, scale=scale, causal=causal,
+                blk_q=blk_q, blk_k=blk_k, out_dtype=out_dtype)
 
 
-def _flash_fwd(q3, k3, v3, offsets, kl, scale, causal, blk_q, blk_k):
+def _flash_fwd(q3, k3, v3, offsets, kl, scale, causal, blk_q, blk_k,
+               out_dtype):
     qo, ko = offsets
-    o3, lse = _fwd(q3, k3, v3, qo, ko, kl,
-                   scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
-    return o3, (q3, k3, v3, o3, lse, qo, ko, kl)
+    o3, lse = _fwd(q3, k3, v3, qo, ko, kl, scale=scale, causal=causal,
+                   blk_q=blk_q, blk_k=blk_k, out_dtype=out_dtype)
+    return (o3, lse), (q3, k3, v3, o3, lse, qo, ko, kl)
 
 
-def _flash_bwd(scale, causal, blk_q, blk_k, res, do3):
+def _flash_bwd(scale, causal, blk_q, blk_k, out_dtype, res, cts):
     q3, k3, v3, o3, lse, qo, ko, kl = res
-    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, qo, ko, kl,
+    do3, dlse = cts
+    dq, dk, dv = _bwd(q3, k3, v3, o3, lse, do3, dlse, qo, ko, kl,
                       scale=scale, causal=causal, blk_q=blk_q, blk_k=blk_k)
     return dq, dk, dv, None, None
 
@@ -359,14 +364,20 @@ def flash_attention(
     k_offset=0,
     block_q: int = _BLK_Q,
     block_k: int = _BLK_K,
-) -> jax.Array:
+    return_lse: bool = False,
+):
     """Blockwise-online attention. q: (B, Sq, H, D); k/v: (B, Sk, H, D).
 
     ``q_offset``/``k_offset`` are the GLOBAL positions of row 0 (ints or
     traced scalars) — sequence-parallel callers pass their shard offsets
     and causality is evaluated in global coordinates, exactly like
     `_ring_attention_local`'s mask. Differentiable via the flash backward
-    kernels (custom VJP).
+    kernels (custom VJP), including through the logsumexp when
+    ``return_lse=True`` (returns ``(out, lse)``: out stays f32 so ring
+    hops merge at accumulator precision — callers downcast once after the
+    final merge; lse is (B, H, Sq) f32, with rows that see no keys at the
+    finite ``_NEG_INF`` sentinel) — the ring layer merges per-hop
+    (out, lse) pairs associatively and gradients flow through both.
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -393,6 +404,13 @@ def flash_attention(
     ko = jnp.asarray([k_offset], jnp.int32)
     kl = jnp.asarray([Sk], jnp.int32)  # valid key length (pre-padding)
 
-    o3 = _flash(q3, k3, v3, (qo, ko), kl, scale, causal, blk_q, blk_k)
-    o3 = o3[:, :Sq]
-    return o3.reshape(B, H, Sq, D).transpose(0, 2, 1, 3).astype(q.dtype)
+    # With lse (the ring's hop engine) the partial output stays f32: hops
+    # merge at accumulator precision and the CALLER downcasts once after
+    # the final merge — the same discipline the einsum ring engine had.
+    out_dtype = jnp.float32 if return_lse else q.dtype
+    o3, lse3 = _flash(q3, k3, v3, (qo, ko), kl, scale, causal,
+                      blk_q, blk_k, jnp.dtype(out_dtype))
+    out = o3[:, :Sq].reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    if not return_lse:
+        return out
+    return out, lse3[:, :Sq].reshape(B, H, Sq)

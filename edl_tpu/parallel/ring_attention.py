@@ -72,11 +72,10 @@ def _ring_attention_local(
     """Ring attention over local shards — call inside a shard_map whose manual
     axes include ``seq_axis``. q/k/v: (B, S_local, H_local, D).
 
-    ``flash``: route the unsharded case through the Pallas blockwise kernel
-    (`edl_tpu.ops.flash_attention`) instead of the O(S^2) dense path. The
-    ring path keeps its einsum block engine for now: its hop merge carries
-    (m, num, den) explicitly, and swapping the block engine for the kernel
-    needs a differentiable-lse variant (future work noted in ops/)."""
+    ``flash``: run every block's attention through the Pallas kernel
+    (`edl_tpu.ops.flash_attention`) instead of the einsum engine — the
+    unsharded case directly, the ring case via per-hop (out, lse) pairs
+    merged associatively (gradients flow through the kernel's lse)."""
     B, S, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if n_shards == 1:
@@ -85,6 +84,11 @@ def _ring_attention_local(
 
             return flash_attention(q, k, v, causal=causal, scale=scale)
         return dense_attention(q, k, v, causal=causal, scale=scale)
+    if flash:
+        return _ring_flash_local(
+            q, k, v, seq_axis=seq_axis, n_shards=n_shards, causal=causal,
+            scale=scale,
+        )
 
     my = jax.lax.axis_index(seq_axis)
     q_pos = my * S + jnp.arange(S)  # global positions of local queries
@@ -130,6 +134,62 @@ def _ring_attention_local(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
+def _ring_flash_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    seq_axis: str,
+    n_shards: int,
+    causal: bool,
+    scale: float,
+) -> jax.Array:
+    """Ring attention with the Pallas kernel as the per-hop block engine.
+
+    Each visiting K/V block runs through `flash_attention(return_lse=True)`
+    with global offsets; hops merge associatively in (out, lse) form:
+    ``lse' = logaddexp(lse_a, lse_b)``, ``out' = out_a e^{lse_a - lse'} +
+    out_b e^{lse_b - lse'}``. Blocks with no visible keys report the finite
+    masked sentinel, whose weight underflows to exactly 0 in the merge, so
+    no special-casing. Gradients flow through the kernel's custom VJP for
+    both outputs."""
+    from edl_tpu.ops import flash_attention
+
+    B, S, H, D = q.shape
+    my = jax.lax.axis_index(seq_axis)
+    ring = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def block(k_blk, v_blk, src):
+        return flash_attention(
+            q, k_blk, v_blk, causal=causal, scale=scale,
+            q_offset=my * S, k_offset=src * S, return_lse=True,
+        )
+
+    def merge(acc, blk):
+        (oa, la), (ob, lb) = acc, blk
+        lse = jnp.logaddexp(la, lb)  # (B, H, S)
+        wa = jnp.exp(la - lse)[..., None].transpose(0, 2, 1, 3)
+        wb = jnp.exp(lb - lse)[..., None].transpose(0, 2, 1, 3)
+        return (
+            oa.astype(jnp.float32) * wa + ob.astype(jnp.float32) * wb,
+            lse,
+        )
+
+    def step(carry, i):
+        k_blk, v_blk, acc = carry
+        k_blk = jax.lax.ppermute(k_blk, seq_axis, ring)
+        v_blk = jax.lax.ppermute(v_blk, seq_axis, ring)
+        acc = merge(acc, block(k_blk, v_blk, src=(my - i) % n_shards))
+        return (k_blk, v_blk, acc), None
+
+    out0, lse0 = block(k, v, src=my)  # local block, hop 0
+    acc0 = (out0.astype(jnp.float32), lse0)
+    (_, _, (out, _)), _ = jax.lax.scan(
+        step, (k, v, acc0), jnp.arange(1, n_shards)
+    )
+    return out.astype(q.dtype)
+
+
 def _qkv_spec(mesh: Mesh, batch_axis: str, seq_axis: str, head_axis: str) -> P:
     """(B, S, H, D) spec using only axes the mesh actually has."""
     have = mesh.axis_names
@@ -152,6 +212,7 @@ def ring_attention(
     head_axis: str = "model",
     causal: bool = True,
     scale: Optional[float] = None,
+    flash: bool = False,
 ) -> jax.Array:
     """Sequence-parallel attention on a mesh. q/k/v: (B, S, H, D) global.
 
@@ -162,6 +223,10 @@ def ring_attention(
     attention under `jit` sharding propagation.
     """
     if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
+        if flash:
+            from edl_tpu.ops import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
         return dense_attention(q, k, v, causal=causal, scale=scale)
     spec = _qkv_spec(mesh, batch_axis, seq_axis, head_axis)
     kernel = partial(
@@ -170,6 +235,7 @@ def ring_attention(
         n_shards=mesh.shape[seq_axis],
         causal=causal,
         scale=scale,
+        flash=flash,
     )
     return shard_map(
         kernel,
