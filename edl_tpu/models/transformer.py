@@ -68,11 +68,12 @@ class TransformerConfig:
     #: per stage — the standard HBM-for-FLOPs trade that makes long-context
     #: training fit (scaling-book recipe; the reference has no analog).
     remat: bool = False
-    #: Pallas flash-attention kernel for the unsharded-sequence case
-    #: (`edl_tpu.ops.flash_attention`): blockwise online softmax in VMEM,
-    #: no (S, S) score materialization. Interpret mode on CPU. The
-    #: seq-sharded ring path keeps its einsum block engine (hop merge
-    #: carries m/num/den explicitly).
+    #: Pallas flash-attention kernel (`edl_tpu.ops.flash_attention`):
+    #: blockwise online softmax in VMEM, no (S, S) score materialization.
+    #: Serves BOTH attention paths — the unsharded-sequence case directly,
+    #: and the seq-sharded ring as its per-hop block engine (hops merge
+    #: associatively in (out, lse) form, gradients flow through the
+    #: kernel's differentiable lse). Interpret mode on CPU.
     flash: bool = True
 
     @property

@@ -218,21 +218,25 @@ def ring_attention(
 
     Sharding: batch over ``batch_axis``, sequence over ``seq_axis``, heads
     over ``head_axis`` (attention is embarrassingly parallel over batch and
-    heads; only the sequence axis communicates). Axes absent from the mesh are
-    simply unsharded. With no ``seq_axis`` in the mesh this degrades to dense
-    attention under `jit` sharding propagation.
+    heads; only the sequence axis communicates). Axes absent from the mesh
+    are simply unsharded. With no ``seq_axis`` in the mesh this degrades to
+    dense attention under `jit` sharding propagation (``flash=False``) or
+    to the Pallas kernel on each device's local batch/head block inside a
+    communication-free shard_map (``flash=True``).
     """
-    if seq_axis not in mesh.axis_names or mesh.shape[seq_axis] == 1:
-        if flash:
-            from edl_tpu.ops import flash_attention
-
-            return flash_attention(q, k, v, causal=causal, scale=scale)
+    n_sp = mesh.shape[seq_axis] if seq_axis in mesh.axis_names else 1
+    if n_sp == 1 and not flash:
         return dense_attention(q, k, v, causal=causal, scale=scale)
+    # flash always goes through shard_map, even with no sequence sharding:
+    # pallas_call has no SPMD partitioning rule, so calling it on global
+    # arrays would force XLA to replicate batch/head-sharded inputs; inside
+    # the manual region it runs on each device's local block. (The dense
+    # fallback stays global — pure jnp ops propagate shardings fine.)
     spec = _qkv_spec(mesh, batch_axis, seq_axis, head_axis)
     kernel = partial(
         _ring_attention_local,
         seq_axis=seq_axis,
-        n_shards=mesh.shape[seq_axis],
+        n_shards=n_sp,
         causal=causal,
         scale=scale,
         flash=flash,

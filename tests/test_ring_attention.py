@@ -130,3 +130,20 @@ def test_flash_ring_bfloat16_matches_einsum_ring():
         np.asarray(flash_ring, np.float32), np.asarray(einsum_ring, np.float32),
         rtol=1e-2, atol=1e-2,
     )
+
+
+def test_no_seq_axis_flash_runs_locally_under_jit():
+    """flash=True with no seq axis: the kernel must run inside a shard_map
+    on each device's batch shard (pallas has no SPMD partitioning rule;
+    outside the manual region XLA would replicate sharded inputs)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = build_mesh(MeshSpec({"data": 8}))
+    q, k, v = _qkv(np.random.default_rng(13), B=8)
+    sh = NamedSharding(mesh, P("data"))
+    qs, ks, vs = (jax.device_put(jnp.asarray(x), sh) for x in (q, k, v))
+    f = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh, flash=True))
+    got = f(qs, ks, vs)
+    want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
