@@ -56,8 +56,10 @@ def main() -> None:
 
     shapes = json.loads(os.environ.get("EDL_FLASH_SHAPES", "null")) \
         or _DEFAULT_SHAPES
-    windows = int(os.environ.get("EDL_BENCH_WINDOWS", "5"))
-    steps = int(os.environ.get("EDL_BENCH_STEPS", "10"))
+    windows = max(1, int(os.environ.get("EDL_BENCH_WINDOWS", "5")))
+    # clamped: this tool has no zero-step probe mode (bench.py's
+    # EDL_BENCH_STEPS=0 convention), and 0 would divide the ms-per-step
+    steps = max(1, int(os.environ.get("EDL_BENCH_STEPS", "10")))
 
     def arm(fn, q, k, v):
         loss = jax.jit(jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2)))

@@ -225,13 +225,22 @@ def ring_attention(
     communication-free shard_map (``flash=True``).
     """
     n_sp = mesh.shape[seq_axis] if seq_axis in mesh.axis_names else 1
-    if n_sp == 1 and not flash:
-        return dense_attention(q, k, v, causal=causal, scale=scale)
-    # flash always goes through shard_map, even with no sequence sharding:
-    # pallas_call has no SPMD partitioning rule, so calling it on global
-    # arrays would force XLA to replicate batch/head-sharded inputs; inside
-    # the manual region it runs on each device's local block. (The dense
-    # fallback stays global — pure jnp ops propagate shardings fine.)
+    if n_sp == 1:
+        if not flash:
+            return dense_attention(q, k, v, causal=causal, scale=scale)
+        # flash prefers the shard_map below even with no sequence sharding
+        # (pallas_call has no SPMD partitioning rule, so on global arrays
+        # XLA would replicate batch/head-sharded inputs), but shard_map
+        # demands divisibility — an indivisible batch/head (e.g. a single
+        # eval sequence on a data mesh) takes the global call instead,
+        # which is always correct, just potentially replicated.
+        B, _, H, _ = q.shape
+        n_b = mesh.shape.get(batch_axis, 1)
+        n_h = mesh.shape.get(head_axis, 1)
+        if B % n_b or H % n_h:
+            from edl_tpu.ops import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
     spec = _qkv_spec(mesh, batch_axis, seq_axis, head_axis)
     kernel = partial(
         _ring_attention_local,

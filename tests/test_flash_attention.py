@@ -174,3 +174,52 @@ def test_fully_masked_rows_within_live_block():
     ))(q)
     assert np.allclose(np.asarray(g)[0, :off], 0.0)
     assert bool(np.isfinite(np.asarray(g)).all())
+
+
+def test_randomized_shapes_and_offsets_property():
+    """Property sweep over the input space the ring can produce: random
+    (B, Sq, Sk, H, D), random global offsets (including key blocks fully
+    or partially in the queries' future), values AND gradients vs a
+    globally-positioned dense oracle."""
+    import math
+
+    rng = np.random.default_rng(42)
+    for trial in range(8):
+        B = int(rng.integers(1, 3))
+        H = int(rng.integers(1, 3))
+        D = int(rng.choice([4, 8, 16]))
+        Sq = int(rng.integers(3, 70))
+        Sk = int(rng.integers(3, 70))
+        q_off = int(rng.integers(0, 50))
+        k_off = int(rng.integers(0, 50))
+        causal = bool(rng.integers(0, 2))
+        q, k, v = rand_qkv(rng, B, Sq, H, D, Sk=Sk)
+
+        def oracle(q, k, v):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(D)
+            if causal:
+                qpos = q_off + jnp.arange(Sq)
+                kpos = k_off + jnp.arange(Sk)
+                mask = kpos[None, :] <= qpos[:, None]
+                s = jnp.where(mask[None, None], s, -1e30)
+                p = jnp.where(mask[None, None],
+                              jax.nn.softmax(s, axis=-1), 0.0)
+            else:
+                p = jax.nn.softmax(s, axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+        got = flash_attention(q, k, v, causal=causal,
+                              q_offset=q_off, k_offset=k_off)
+        want = oracle(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=3e-5, atol=3e-5,
+            err_msg=f"trial {trial}: B={B} Sq={Sq} Sk={Sk} H={H} D={D} "
+                    f"qo={q_off} ko={k_off} causal={causal}",
+        )
+        gf = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, causal=causal, q_offset=q_off, k_offset=k_off) ** 2))(q)
+        gd = jax.grad(lambda q: jnp.sum(oracle(q, k, v) ** 2))(q)
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), rtol=3e-4, atol=3e-4,
+            err_msg=f"grad trial {trial}",
+        )

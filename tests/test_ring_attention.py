@@ -147,3 +147,15 @@ def test_no_seq_axis_flash_runs_locally_under_jit():
     want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_no_seq_axis_flash_indivisible_batch_falls_back_global():
+    """B=1 on a data=8 mesh: shard_map's divisibility would reject it; the
+    entrypoint must fall back to the global kernel call and stay correct."""
+    mesh = build_mesh(MeshSpec({"data": 8}))
+    q, k, v = _qkv(np.random.default_rng(14), B=1)
+    got = ring_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                         mesh, flash=True)
+    want = dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
