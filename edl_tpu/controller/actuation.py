@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 from edl_tpu.api.types import TrainingJob
 from edl_tpu.controller.jobparser import coordinator_endpoint

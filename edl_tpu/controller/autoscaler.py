@@ -22,12 +22,12 @@ TPU-specific decisions (SURVEY §7 hard part 3):
 
 from __future__ import annotations
 
-import dataclasses
+
 import logging
 import queue
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from edl_tpu.api.quantity import ResourceList

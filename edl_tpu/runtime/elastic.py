@@ -29,7 +29,6 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
-import numpy as np
 from jax.sharding import Mesh
 
 from edl_tpu.models.base import Model
