@@ -1,0 +1,207 @@
+"""Tunnel-watch runner: executes the on-chip validation campaign.
+
+The axon tunnel serving the one real TPU goes hard-down for hours
+(BENCH_NOTES.md); round 4 shipped its flagship Pallas code without a
+single on-chip execution because the window never reopened. This runner
+inverts the race: it probes the tunnel cheaply in a subprocess (so a
+hanging ``jax.devices()`` can't wedge it) and, the moment the chip is
+reachable, runs the campaign steps in priority order, capturing every
+artifact. Progress is checkpointed to CAMPAIGN_STATUS.json so a restart
+resumes where it left off instead of burning scarce tunnel time.
+
+Usage:
+  python onchip_campaign.py            # wait for tunnel, run all steps
+  python onchip_campaign.py --once     # single probe, exit 1 if down
+  EDL_CAMPAIGN_STEPS=flash_check,bench_flash python onchip_campaign.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+LOG_DIR = os.path.join(HERE, "onchip_logs")
+STATUS_PATH = os.path.join(HERE, "CAMPAIGN_STATUS.json")
+PROBE_INTERVAL = float(os.environ.get("EDL_PROBE_INTERVAL", "180"))
+PROBE_TIMEOUT = float(os.environ.get("EDL_PROBE_TIMEOUT", "120"))
+MAX_ATTEMPTS = int(os.environ.get("EDL_CAMPAIGN_ATTEMPTS", "3"))
+
+#: name -> (argv, per-step timeout sec, stdout-JSON artifact or None).
+#: Steps whose script writes its own artifact pass None. Priority order.
+STEPS = [
+    ("flash_check", [sys.executable, "onchip_flash_check.py"], 2400, None),
+    ("bench_flash", [sys.executable, "bench_flash.py"], 3600,
+     "BENCH_FLASH.json"),
+    ("bench_synth", [sys.executable, "bench.py"], 2400,
+     "BENCH_SYNTH_ONCHIP.json"),
+    ("bench_file", [sys.executable, "bench.py"], 3000,
+     "BENCH_FILE_ONCHIP.json"),
+    ("flash_sweep", [sys.executable, "onchip_flash_sweep.py"], 3600, None),
+    ("bench_lm", [sys.executable, "bench_lm.py"], 3600,
+     "BENCH_LM_ONCHIP.json"),
+    ("rescale_onchip", [sys.executable, "bench_rescale_onchip.py"], 2400,
+     None),
+]
+
+STEP_ENV = {
+    "bench_file": {"EDL_BENCH_MODE": "file"},
+}
+
+
+def log(msg: str) -> None:
+    print(f"[campaign {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def step_env(name: str) -> dict:
+    env = dict(os.environ)
+    # The axon plugin rides PYTHONPATH; append the repo so bare scripts
+    # resolve `edl_tpu` (background shells don't inherit cwd sys.path).
+    parts = [p for p in env.get("PYTHONPATH", "").split(":") if p]
+    for need in ("/root/.axon_site", HERE):
+        if need not in parts:
+            parts.append(need)
+    env["PYTHONPATH"] = ":".join(parts)
+    # The runner just verified the tunnel; don't let a step sit in the
+    # 300 s default dial loop if it flaps mid-campaign.
+    env.setdefault("EDL_BENCH_INIT_TIMEOUT", "240")
+    env.update(STEP_ENV.get(name, {}))
+    return env
+
+
+def tunnel_up() -> bool:
+    """Probe jax.devices() in a throwaway subprocess with a hard timeout."""
+    code = (
+        "import jax; d = jax.devices(); "
+        "assert any(x.platform != 'cpu' for x in d), d; print(d)"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            env=step_env("probe"), cwd=HERE, timeout=PROBE_TIMEOUT,
+            capture_output=True, text=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def load_status() -> dict:
+    try:
+        with open(STATUS_PATH) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"steps": {}}
+
+
+def save_status(status: dict) -> None:
+    tmp = STATUS_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(status, f, indent=1)
+    os.replace(tmp, STATUS_PATH)
+
+
+def extract_json_lines(text: str):
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def run_step(name: str, argv, timeout: float, artifact) -> dict:
+    os.makedirs(LOG_DIR, exist_ok=True)
+    log_path = os.path.join(LOG_DIR, f"{name}.log")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            argv, env=step_env(name), cwd=HERE, timeout=timeout,
+            capture_output=True, text=True,
+        )
+        rc, out, err = r.returncode, r.stdout, r.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = -9
+        out = (e.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = f"TIMEOUT after {timeout}s"
+    with open(log_path, "w") as f:
+        f.write(out)
+        f.write("\n--- stderr ---\n")
+        f.write(err[-20000:])
+
+    records = extract_json_lines(out)
+    # A step "ran" if it exited 0 AND produced at least one JSON record
+    # that is not a backend-unavailable error.
+    usable = [r_ for r_ in records if "error" not in r_]
+    ok = rc == 0 and bool(usable)
+    if ok and artifact:
+        with open(os.path.join(HERE, artifact), "w") as f:
+            if len(records) == 1:
+                json.dump(records[0], f, indent=1)
+            else:
+                json.dump(records, f, indent=1)
+    return {
+        "ok": ok,
+        "returncode": rc,
+        "seconds": round(time.time() - t0, 1),
+        "records": len(records),
+        "errors": [r_["error"][:200] for r_ in records if "error" in r_],
+        "log": os.path.relpath(log_path, HERE),
+    }
+
+
+def main() -> int:
+    selected = os.environ.get("EDL_CAMPAIGN_STEPS")
+    base_steps = STEPS
+    if selected:
+        want = set(selected.split(","))
+        base_steps = [s for s in STEPS if s[0] in want]
+
+    status = load_status()
+    once = "--once" in sys.argv
+
+    while True:
+        # Re-scan each cycle: steps whose script doesn't exist yet (written
+        # later in the round) join the campaign as soon as the file lands.
+        steps = [
+            s for s in base_steps
+            if os.path.exists(os.path.join(HERE, s[1][1]))
+        ]
+        pending = [
+            s for s in steps
+            if not status["steps"].get(s[0], {}).get("ok")
+            and status["steps"].get(s[0], {}).get("attempts", 0) < MAX_ATTEMPTS
+        ]
+        if not pending:
+            log("campaign complete")
+            save_status(status)
+            return 0
+        if not tunnel_up():
+            if once:
+                log("tunnel down (--once)")
+                return 1
+            log(f"tunnel down; {len(pending)} steps pending; "
+                f"sleeping {PROBE_INTERVAL:.0f}s")
+            time.sleep(PROBE_INTERVAL)
+            continue
+        name, argv, timeout, artifact = pending[0]
+        entry = status["steps"].setdefault(name, {"attempts": 0})
+        entry["attempts"] += 1
+        log(f"tunnel UP; running {name} (attempt {entry['attempts']})")
+        result = run_step(name, argv, timeout, artifact)
+        entry.update(result)
+        entry["finished_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+        save_status(status)
+        log(f"{name}: ok={result['ok']} rc={result['returncode']} "
+            f"in {result['seconds']}s")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""On-chip flash attention validation: real Pallas lowering, not interpret.
+
+The flash kernels (`edl_tpu/ops/flash_attention.py`) auto-select interpret
+mode on CPU, so the test suite exercises the *program* but never TPU
+lowering (tile layouts, VMEM budgets, SMEM scalar plumbing). This script
+runs forward + backward NON-interpret on the live accelerator across the
+shapes the framework actually uses — aligned, padded, offset (ring hop
+semantics), lse-returning — and checks numerics against the dense oracle
+on the same backend. Writes FLASH_ONCHIP.json and prints one JSON line.
+
+Run by the on-chip campaign runner (onchip_campaign.py) whenever the
+tunnel is up; safe to re-run any time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+#: (B, S, H, D, causal, dtype) — covers aligned, pad-up, long-S, f32+bf16
+_CASES = [
+    dict(B=2, S=1024, H=4, D=64, causal=True, dtype="float32"),
+    dict(B=2, S=1024, H=4, D=64, causal=True, dtype="bfloat16"),
+    dict(B=1, S=640, H=2, D=64, causal=True, dtype="float32"),   # pads to blk
+    dict(B=1, S=2048, H=8, D=128, causal=True, dtype="bfloat16"),
+    dict(B=2, S=512, H=4, D=64, causal=False, dtype="float32"),
+]
+
+#: f32 inputs should match the f32-softmax oracle tightly; bf16 inputs
+#: lose mantissa in the QK^T operands themselves.
+_TOL = {"float32": 2e-3, "bfloat16": 3e-2}
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("EDL_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["EDL_BENCH_PLATFORM"])
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench import probe_devices
+
+    devices, reason = probe_devices(
+        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
+        allow_cpu=os.environ.get("EDL_BENCH_ALLOW_CPU") == "1"
+        or os.environ.get("EDL_BENCH_PLATFORM") == "cpu",
+    )
+    if devices is None:
+        print(json.dumps({"metric": "flash_onchip_check", "error": reason}))
+        os._exit(0)
+    backend = devices[0].platform
+
+    from edl_tpu.ops import flash_attention
+    from edl_tpu.parallel.ring_attention import dense_attention
+
+    rng = np.random.default_rng(0)
+    results = []
+    n_fail = 0
+    for case in _CASES:
+        B, S, H, D = case["B"], case["S"], case["H"], case["D"]
+        causal, dtype = case["causal"], case["dtype"]
+        tol = _TOL[dtype]
+        rec = dict(case)
+        t0 = time.perf_counter()
+        try:
+            q = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+            k = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+            v = jnp.asarray(rng.standard_normal((B, S, H, D)), dtype)
+
+            def loss_flash(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal=causal) ** 2
+                )
+
+            def loss_dense(q, k, v):
+                return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+            out_f = jax.jit(
+                lambda q, k, v: flash_attention(q, k, v, causal=causal)
+            )(q, k, v)
+            out_d = dense_attention(q, k, v, causal=causal)
+            gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+            gd = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+
+            def rel_err(a, b):
+                a = np.asarray(a, np.float32)
+                b = np.asarray(b, np.float32)
+                denom = max(1e-6, float(np.max(np.abs(b))))
+                return float(np.max(np.abs(a - b))) / denom
+
+            errs = {
+                "out": rel_err(out_f, out_d),
+                "dq": rel_err(gf[0], gd[0]),
+                "dk": rel_err(gf[1], gd[1]),
+                "dv": rel_err(gf[2], gd[2]),
+            }
+            # lse path (the ring hop engine) on real lowering too
+            out_lse, lse = jax.jit(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=causal, return_lse=True
+                )
+            )(q, k, v)
+            jax.block_until_ready(lse)
+            rec.update(
+                rel_err=errs,
+                lse_finite=bool(np.isfinite(np.asarray(lse)).all()),
+                ok=all(e <= tol for e in errs.values()),
+                seconds=round(time.perf_counter() - t0, 2),
+            )
+        except Exception as e:  # noqa: BLE001 — a lowering failure IS the result
+            rec.update(ok=False, error=str(e)[:500],
+                       seconds=round(time.perf_counter() - t0, 2))
+        n_fail += not rec["ok"]
+        results.append(rec)
+
+    summary = {
+        "metric": "flash_onchip_check",
+        "backend": backend,
+        "interpret_mode": backend == "cpu",
+        "cases": len(results),
+        "failed": n_fail,
+        "ok": n_fail == 0,
+        "results": results,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "FLASH_ONCHIP.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    print(json.dumps({k: summary[k] for k in
+                      ("metric", "backend", "cases", "failed", "ok")}))
+
+
+if __name__ == "__main__":
+    main()
