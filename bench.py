@@ -96,6 +96,32 @@ def probe_devices(init_timeout: float, allow_cpu: bool):
     return devices, None
 
 
+def probe_or_exit(metric: str, unit: str = ""):
+    """Shared bench preamble: platform override, device probe, and — when
+    the accelerator is unreachable — one flushed error-JSON line followed by
+    a hard exit (the init thread may still be blocked dialing). Returns the
+    device list on success. Keeps the dial-timeout/CPU-guard semantics in
+    one place for bench.py / bench_lm.py / onchip_flash_check.py."""
+    import jax
+
+    if os.environ.get("EDL_BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["EDL_BENCH_PLATFORM"])
+    devices, reason = probe_devices(
+        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
+        allow_cpu=os.environ.get("EDL_BENCH_ALLOW_CPU") == "1"
+        or os.environ.get("EDL_BENCH_PLATFORM") == "cpu",
+    )
+    if devices is None:
+        record = {"metric": metric, "value": 0.0, "vs_baseline": 0.0,
+                  "error": reason}
+        if unit:
+            record["unit"] = unit
+        print(json.dumps(record))
+        sys.stdout.flush()
+        os._exit(0)
+    return devices
+
+
 def median_of_best(rates, keep: int) -> float:
     return statistics.median(sorted(rates, reverse=True)[: max(1, keep)])
 
@@ -112,31 +138,9 @@ def main() -> None:
     import jax
     import numpy as np
 
-    # Deliberate platform override (e.g. EDL_BENCH_PLATFORM=cpu for harness
-    # verification): must go through jax.config, because this image's
-    # sitecustomize force-selects the axon backend and IGNORES the
-    # JAX_PLATFORMS env var (see .claude/skills/verify).
-    if os.environ.get("EDL_BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["EDL_BENCH_PLATFORM"])
-
-    devices, reason = probe_devices(
-        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
-        allow_cpu=os.environ.get("EDL_BENCH_ALLOW_CPU") == "1",
+    devices = probe_or_exit(
+        "ctr_train_samples_per_sec_per_chip", "samples/s/chip"
     )
-    if devices is None:
-        print(
-            json.dumps(
-                {
-                    "metric": "ctr_train_samples_per_sec_per_chip",
-                    "value": 0.0,
-                    "unit": "samples/s/chip",
-                    "vs_baseline": 0.0,
-                    "error": reason,
-                }
-            )
-        )
-        sys.stdout.flush()
-        os._exit(0)  # the init thread may still be blocked dialing
     n_chips = len(devices)
 
     from edl_tpu.models import ctr
@@ -256,6 +260,17 @@ def main() -> None:
     raw_per_chip = median_of_best(raw_rates, keep) / n_chips
     vs_baseline = statistics.median(ratios) if ratios else 1.0
 
+    from edl_tpu.tools.mfu import mfu_fields
+
+    accounting = mfu_fields(
+        model,
+        batch_size,
+        steps_per_sec=median_of_best(wire_rates, keep) / batch_size,
+        n_chips=n_chips,
+        device=devices[0],
+        mesh=mesh,
+    )
+
     here = os.path.dirname(os.path.abspath(__file__))
     if record_baseline:
         with open(os.path.join(here, "BENCH_BASELINE.json"), "w") as f:
@@ -292,6 +307,7 @@ def main() -> None:
                 ],
                 "paired_ratios": [round(r, 4) for r in ratios],
                 "median_of_best": keep,
+                **accounting,
                 "pairing": (
                     "vs_baseline = median per-pair ratio of interleaved "
                     "wire/raw windows in one process (cross-run comparison "

@@ -53,3 +53,11 @@ class Model:
     #: optional structured config the model was built from (e.g. a
     #: ResNetConfig/TransformerConfig) for forward helpers and export.
     config: Optional[Any] = None
+    #: optional analytic (batch_size) -> train-step model FLOPs. Convention:
+    #: matmul/conv FLOPs only (2*M*N*K per matmul), causal attention halved,
+    #: backward = 2x forward (so train = 3x forward), rematerialization
+    #: recompute EXCLUDED — i.e. the numerator of "model FLOPs utilization"
+    #: in the standard (PaLM-appendix) sense, so bench MFU numbers are
+    #: comparable to published ones. `edl_tpu.tools.mfu` falls back to XLA
+    #: cost analysis when absent.
+    flops_per_step: Optional[Callable] = None

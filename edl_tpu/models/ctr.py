@@ -123,6 +123,16 @@ def synthetic_batch(
     return {"dense": dense, "sparse": sparse, "label": label}
 
 
+def _flops_per_step(batch_size: int) -> float:
+    """Train-step model FLOPs (MFU numerator, models.base convention).
+    The deep MLP dominates; table gathers and the wide path are lookups
+    and tiny reductions, not matmul FLOPs."""
+    dims = [NUM_DENSE + NUM_SPARSE * EMBED_DIM, *HIDDEN, 1]
+    fwd = sum(2 * a * b for a, b in zip(dims[:-1], dims[1:]))
+    fwd += 2 * NUM_DENSE  # wide dense linear
+    return 3.0 * fwd * batch_size
+
+
 def make_model(
     shard_axis: str = SHARD_AXIS,
     batch_axis: str = "data",
@@ -145,6 +155,7 @@ def make_model(
         predict=lambda params, batch, mesh: _forward_impl(
             params, batch["dense"], batch["sparse"], mesh, deep, wide
         ),
+        flops_per_step=_flops_per_step,
     )
 
 

@@ -62,4 +62,6 @@ MODEL = Model(
     synthetic_batch=synthetic_batch,
     label_keys=("y",),
     predict=predict,
+    # MFU numerator (models.base convention): one (B, 13) @ (13, 1) matmul.
+    flops_per_step=lambda bs: 3.0 * 2 * NUM_FEATURES * bs,
 )

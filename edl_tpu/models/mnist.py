@@ -106,6 +106,15 @@ def synthetic_batch(rng: np.random.Generator, batch_size: int) -> dict:
     return {"image": image, "label": label}
 
 
+#: MFU numerator per image: conv1 (24^2 out, 5x5x1 -> 20) + conv2 (8^2 out,
+#: 5x5x20 -> 50) + fc 800 -> 500 -> 10, at 2 FLOPs per MAC.
+_FWD_FLOPS = (
+    2 * 24 * 24 * 5 * 5 * 1 * 20
+    + 2 * 8 * 8 * 5 * 5 * 20 * 50
+    + 2 * 800 * 500
+    + 2 * 500 * 10
+)
+
 MODEL = Model(
     name="mnist",
     init=init,
@@ -114,4 +123,5 @@ MODEL = Model(
     synthetic_batch=synthetic_batch,
     label_keys=("label",),
     predict=lambda params, batch, mesh: apply(params, batch["image"]),
+    flops_per_step=lambda bs: 3.0 * _FWD_FLOPS * bs,
 )

@@ -282,6 +282,21 @@ def synthetic_batch(cfg: TransformerConfig, rng: np.random.Generator, batch_size
     return {"tokens": ids[:, :-1], "targets": ids[:, 1:]}
 
 
+def _flops_per_step(cfg: TransformerConfig, batch_size: int) -> float:
+    """Train-step model FLOPs (MFU numerator; see models.base convention).
+
+    Per token forward: qkv 6D^2 + out-proj 2D^2 + ffn 4DF per layer, plus
+    causal attention (QK^T and PV are 2*S*D each, halved by the mask) and
+    the LM head 2DV. Backward = 2x forward; remat recompute excluded.
+    """
+    D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    per_token = (
+        L * (6 * D * D + 2 * D * D + 4 * D * F + 0.5 * (4 * cfg.seq_len * D))
+        + 2 * D * cfg.vocab_size
+    )
+    return 3.0 * per_token * cfg.seq_len * batch_size
+
+
 def make_model(cfg: Optional[TransformerConfig] = None, **overrides) -> Model:
     cfg = cfg or TransformerConfig(**overrides)
     return Model(
@@ -292,6 +307,8 @@ def make_model(cfg: Optional[TransformerConfig] = None, **overrides) -> Model:
         synthetic_batch=lambda rng, bs: synthetic_batch(cfg, rng, bs),
         batch_spec=lambda mesh: _batch_specs(cfg, mesh),
         label_keys=("targets",),
+        config=cfg,
+        flops_per_step=lambda bs: _flops_per_step(cfg, bs),
     )
 
 

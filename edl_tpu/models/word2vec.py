@@ -91,4 +91,9 @@ MODEL = Model(
     synthetic_batch=synthetic_batch,
     label_keys=("target",),
     predict=predict,
+    # MFU numerator: hidden (128 -> 256) + softmax projection (256 -> vocab);
+    # the sharded table lookup is a gather, not matmul FLOPs.
+    flops_per_step=lambda bs: 3.0 * bs * (
+        2 * CONTEXT * EMBED_DIM * HIDDEN + 2 * HIDDEN * VOCAB
+    ),
 )

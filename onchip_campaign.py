@@ -180,8 +180,16 @@ def main() -> int:
             and status["steps"].get(s[0], {}).get("attempts", 0) < MAX_ATTEMPTS
         ]
         if not pending:
-            log("campaign complete")
+            failed = [
+                s[0] for s in steps
+                if not status["steps"].get(s[0], {}).get("ok")
+            ]
             save_status(status)
+            if failed:
+                log(f"campaign finished with FAILED steps: {failed} "
+                    f"(details in CAMPAIGN_STATUS.json)")
+                return 2
+            log("campaign complete")
             return 0
         if not tunnel_up():
             if once:

@@ -35,23 +35,12 @@ _TOL = {"float32": 2e-3, "bfloat16": 3e-2}
 
 def main() -> None:
     import jax
-
-    if os.environ.get("EDL_BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["EDL_BENCH_PLATFORM"])
-
     import jax.numpy as jnp
     import numpy as np
 
-    from bench import probe_devices
+    from bench import probe_or_exit
 
-    devices, reason = probe_devices(
-        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
-        allow_cpu=os.environ.get("EDL_BENCH_ALLOW_CPU") == "1"
-        or os.environ.get("EDL_BENCH_PLATFORM") == "cpu",
-    )
-    if devices is None:
-        print(json.dumps({"metric": "flash_onchip_check", "error": reason}))
-        os._exit(0)
+    devices = probe_or_exit("flash_onchip_check")
     backend = devices[0].platform
 
     from edl_tpu.ops import flash_attention
