@@ -44,9 +44,6 @@ __all__ = ["flash_attention"]
 #: finite "masked" score: exp() is exactly 0.0 without nan risk
 _NEG_INF = -1e30
 
-#: default VMEM tile extents (MXU-aligned)
-_BLK_Q = 128
-_BLK_K = 128
 
 
 def _interpret() -> bool:
@@ -362,8 +359,8 @@ def flash_attention(
     scale: Optional[float] = None,
     q_offset=0,
     k_offset=0,
-    block_q: int = _BLK_Q,
-    block_k: int = _BLK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     return_lse: bool = False,
 ):
     """Blockwise-online attention. q: (B, Sq, H, D); k/v: (B, Sk, H, D).
@@ -382,6 +379,15 @@ def flash_attention(
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    # Unpinned blocks resolve through the on-chip-swept tuning table
+    # (ops/flash_tuning.py); 128x128 wherever the table is silent.
+    if block_q is None or block_k is None:
+        from edl_tpu.ops import flash_tuning
+
+        tq, tk = flash_tuning.lookup(Sk, D, q.dtype)
+        block_q = block_q if block_q is not None else tq
+        block_k = block_k if block_k is not None else tk
 
     def round_up(n, m):
         return ((n + m - 1) // m) * m

@@ -1,0 +1,78 @@
+"""Tuned block-size defaults for the flash attention kernel.
+
+The kernel's VMEM tile extents (``block_q`` x ``block_k``) set its
+arithmetic intensity; the right point depends on sequence length, head
+dim, and dtype, and only an on-chip sweep can find it (interpret mode has
+no VMEM). ``onchip_flash_sweep.py`` runs that sweep on the live chip and
+persists the winners to ``flash_blocks.json`` next to this module; the
+kernel consults :func:`lookup` whenever the caller didn't pin blocks
+explicitly, falling back to the conservative 128x128 MXU-aligned default
+everywhere the table is silent.
+
+Key scheme: ``"{S_bucket},{D},{dtype}"`` where ``S_bucket`` is the key
+sequence length rounded DOWN to a power of two (the sweep measures at
+powers of two; between them the lower bucket's blocks are the safe
+choice — smaller S tolerates smaller tiles, never larger VMEM).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+#: conservative MXU-aligned fallback (sublane x lane)
+DEFAULT_BLOCKS: Tuple[int, int] = (128, 128)
+
+_TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "flash_blocks.json")
+
+
+def _bucket(s: int) -> int:
+    b = 128
+    while b * 2 <= s:
+        b *= 2
+    return b
+
+
+def _key(s_bucket: int, d: int, dtype: str) -> str:
+    return f"{s_bucket},{d},{dtype}"
+
+
+@functools.lru_cache(maxsize=1)
+def _load_table(path: str = _TABLE_PATH) -> Dict[str, Tuple[int, int]]:
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        return {k: tuple(v) for k, v in raw.get("blocks", {}).items()}
+    except (OSError, ValueError):
+        return {}
+
+
+def lookup(seq_len: int, head_dim: int, dtype, *,
+           path: Optional[str] = None) -> Tuple[int, int]:
+    """Tuned (block_q, block_k) for a key-sequence length / head dim /
+    dtype, falling back through coarser dtype-agnostic entries to the
+    128x128 default. Never returns blocks larger than the sweep proved."""
+    table = _load_table(path) if path else _load_table()
+    dtype = str(dtype)
+    sb = _bucket(max(128, seq_len))
+    while sb >= 128:
+        for key in (_key(sb, head_dim, dtype), _key(sb, head_dim, "any")):
+            if key in table:
+                return table[key]
+        sb //= 2
+    return DEFAULT_BLOCKS
+
+
+def save_table(blocks: Dict[str, Tuple[int, int]], meta: Dict,
+               path: str = _TABLE_PATH) -> None:
+    """Persist sweep winners (called by onchip_flash_sweep.py); clears the
+    lookup cache so the running process sees the new table."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"blocks": {k: list(v) for k, v in blocks.items()},
+                   "meta": meta}, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    _load_table.cache_clear()

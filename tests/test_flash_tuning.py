@@ -1,0 +1,54 @@
+"""Tuned block-size table (ops/flash_tuning) and its kernel wiring."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from edl_tpu.ops import flash_attention, flash_tuning
+from edl_tpu.parallel.ring_attention import dense_attention
+
+
+def test_bucket_rounds_down_to_power_of_two():
+    assert flash_tuning._bucket(128) == 128
+    assert flash_tuning._bucket(1000) == 512
+    assert flash_tuning._bucket(1024) == 1024
+    assert flash_tuning._bucket(1500) == 1024
+
+
+def test_lookup_default_when_table_absent(tmp_path):
+    path = str(tmp_path / "missing.json")
+    flash_tuning._load_table.cache_clear()
+    assert flash_tuning.lookup(2048, 64, "bfloat16", path=path) == \
+        flash_tuning.DEFAULT_BLOCKS
+    flash_tuning._load_table.cache_clear()
+
+
+def test_save_then_lookup_roundtrip(tmp_path):
+    path = str(tmp_path / "blocks.json")
+    flash_tuning.save_table(
+        {flash_tuning._key(2048, 64, "bfloat16"): (256, 512),
+         flash_tuning._key(1024, 64, "any"): (256, 128)},
+        {"note": "test"}, path=path,
+    )
+    flash_tuning._load_table.cache_clear()
+    # exact dtype match at the bucket
+    assert flash_tuning.lookup(2048, 64, "bfloat16", path=path) == (256, 512)
+    # S between buckets falls to the lower bucket's dtype-agnostic entry
+    assert flash_tuning.lookup(1500, 64, "bfloat16", path=path) == (256, 128)
+    # f32 at 2048 misses the bf16 entry, falls through to 1024's "any"
+    assert flash_tuning.lookup(2048, 64, "float32", path=path) == (256, 128)
+    # unknown head dim: conservative default
+    assert flash_tuning.lookup(2048, 128, "bfloat16", path=path) == \
+        flash_tuning.DEFAULT_BLOCKS
+    flash_tuning._load_table.cache_clear()
+
+
+def test_kernel_correct_with_explicit_nondefault_blocks():
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 512, 2, 64)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 512, 2, 64)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 512, 2, 64)), jnp.float32)
+    want = dense_attention(q, k, v, causal=True)
+    for bq, bk in ((256, 128), (128, 256), (256, 256)):
+        got = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-3, rtol=2e-3)
