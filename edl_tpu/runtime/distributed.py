@@ -43,6 +43,8 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from edl_tpu.coordinator import CoordinatorError
+
 log = logging.getLogger("edl_tpu.distributed")
 
 #: KV key prefix rank 0 publishes the jax.distributed endpoint under; the
@@ -137,7 +139,13 @@ def derive_identity(
             st = {}
             if now - last_drain_check >= 2.0:
                 last_drain_check = now
-                st = client.status()
+                try:
+                    st = client.status()
+                except CoordinatorError:
+                    # A timed-out probe during the coordinator's busiest
+                    # window is "not drained", not a bring-up failure —
+                    # the loop keeps registering and retrying.
+                    st = {}
             if (st
                     and int(st.get("queued", 0)) == 0
                     and int(st.get("leased", 0)) == 0

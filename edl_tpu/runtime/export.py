@@ -37,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
@@ -51,7 +52,8 @@ MANIFEST = "manifest.json"
 _FORMAT = 1
 #: weights files kept besides the live one: grace for a reader that loaded
 #: an older manifest just before a newer export landed
-_KEEP_OLD_WEIGHTS = 1
+#: orphaned .tmp files older than this are swept during the GC pass
+_TMP_SWEEP_AGE_SEC = 300.0
 
 
 def _encode_path(path) -> list:
@@ -125,14 +127,15 @@ def _write_artifact(directory, model_ref, host_flat, config, step) -> None:
     # restored checkpoint and the crash would otherwise overwrite a newer
     # manifest with older weights. Writer-local by design (the collective
     # gather already ran on every rank).
+    try:
+        with open(os.path.join(directory, MANIFEST)) as f:
+            prev_manifest = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        prev_manifest = {}
     if step is not None:
-        try:
-            with open(os.path.join(directory, MANIFEST)) as f:
-                published = json.load(f).get("step")
-            if published is not None and published >= step:
-                return
-        except (FileNotFoundError, json.JSONDecodeError):
-            pass
+        published = prev_manifest.get("step")
+        if published is not None and published >= step:
+            return
     arrays: Dict[str, np.ndarray] = {}
     leaves = []
     for i, (path, arr) in enumerate(host_flat):
@@ -173,16 +176,28 @@ def _write_artifact(directory, model_ref, host_flat, config, step) -> None:
     with os.fdopen(fd, "w") as f:
         json.dump(manifest, f)
     os.replace(tmp, os.path.join(directory, MANIFEST))
-    # GC superseded weights, keeping one generation of grace for readers
-    # holding the previous manifest.
-    old = sorted(
-        (p for p in os.listdir(directory)
-         if p.startswith("params-") and p.endswith(".npz")
-         and p != weights_name),
-        key=lambda p: os.path.getmtime(os.path.join(directory, p)),
-    )
-    for stale in old[: max(0, len(old) - _KEEP_OLD_WEIGHTS)]:
-        os.unlink(os.path.join(directory, stale))
+    # GC superseded weights. The grace generation is EXACTLY the file the
+    # just-replaced manifest named (a reader that paired that manifest with
+    # its weights must still find them); everything else is unreachable —
+    # no reachable manifest names it — and goes. Filename-step or mtime
+    # heuristics can both misidentify the grace file (step-less "final"
+    # saves, coarse mtimes), so the manifest itself is the source of truth.
+    spare = {weights_name, prev_manifest.get("weights")}
+    for stale in os.listdir(directory):
+        if (stale.startswith("params-") and stale.endswith(".npz")
+                and stale not in spare):
+            os.unlink(os.path.join(directory, stale))
+    # Sweep orphaned mkstemp leftovers (a writer that died between mkstemp
+    # and os.replace); age-gated so a concurrent writer's live tmp survives.
+    now = time.time()
+    for p in os.listdir(directory):
+        if p.endswith((".npz.tmp", ".json.tmp")):
+            full = os.path.join(directory, p)
+            try:
+                if now - os.path.getmtime(full) > _TMP_SWEEP_AGE_SEC:
+                    os.unlink(full)
+            except OSError:
+                pass  # already gone or being replaced
 
 
 def save_inference_model(

@@ -285,11 +285,20 @@ class MultiHostWorker:
         at a round boundary so no peer is abandoned mid-collective."""
         log.info("SIGTERM drain: requeueing %d uncovered shards, leaving",
                  len(self._uncommitted))
+        consecutive_failures = 0
         for task in self._uncommitted:
             try:
                 self.client.fail_task(task)
-            except Exception:  # noqa: BLE001 — leaving anyway; TTL covers it
-                break
+                consecutive_failures = 0
+            except Exception:  # noqa: BLE001 — CoordinatorError wraps all
+                # transport failures, so one exception can't distinguish a
+                # transient hiccup (keep draining) from a dead coordinator
+                # (every further call burns a full reconnect timeout inside
+                # the pod's termination grace). Two in a row = gone; TTL
+                # expiry covers whatever this drain didn't requeue.
+                consecutive_failures += 1
+                if consecutive_failures >= 2:
+                    break
         self._uncommitted.clear()
         try:
             self.client.leave()

@@ -199,3 +199,47 @@ def test_elastic_worker_exports_during_training(tmp_path):
     # trained params: predictions correlate strongly with true targets
     corr = np.corrcoef(pred.ravel(), batch["y"].ravel())[0, 1]
     assert corr > 0.9
+
+
+def test_gc_spares_exactly_the_previous_manifests_weights(tmp_path):
+    """The grace generation is the file the just-replaced manifest named —
+    mtime forgery or a lingering step-less 'final' save must not steal the
+    slot from the file an in-flight reader may still be loading."""
+    import time as _time
+
+    mesh = single_mesh()
+    model = fit_a_line.MODEL
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    d = str(tmp_path / "gc")
+    save_inference_model(d, "fit_a_line", params)  # params-final-<uuid>
+    for step in (10, 20):
+        save_inference_model(d, "fit_a_line", params, step=step)
+    # forge a stale mtime ON THE GRACE file: mtime ordering would GC
+    # params-20 (which the current manifest names) and keep params-10
+    now = _time.time()
+    os.utime(os.path.join(d, "params-10.npz"), (now + 100, now + 100))
+    os.utime(os.path.join(d, "params-20.npz"), (now - 100, now - 100))
+    save_inference_model(d, "fit_a_line", params, step=30)
+    names = {p for p in os.listdir(d) if p.endswith(".npz")}
+    # the stale final save and params-10 are unreachable from any manifest
+    assert names == {"params-20.npz", "params-30.npz"}
+
+
+def test_gc_sweeps_stale_tmp_files(tmp_path):
+    import time as _time
+
+    mesh = single_mesh()
+    model = fit_a_line.MODEL
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    d = str(tmp_path / "tmpsweep")
+    save_inference_model(d, "fit_a_line", params, step=1)
+    stale = os.path.join(d, "orphan.npz.tmp")
+    fresh = os.path.join(d, "live.json.tmp")
+    for p in (stale, fresh):
+        with open(p, "w") as f:
+            f.write("x")
+    old = _time.time() - 3600
+    os.utime(stale, (old, old))  # orphan from a dead writer
+    save_inference_model(d, "fit_a_line", params, step=2)
+    assert not os.path.exists(stale), "aged orphan tmp should be swept"
+    assert os.path.exists(fresh), "recent tmp (concurrent writer) survives"

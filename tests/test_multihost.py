@@ -512,3 +512,61 @@ def test_two_process_export_gathers_sharded_tables(tmp_path):
         {"dense": batch["dense"], "sparse": batch["sparse"]}
     ))
     assert logits.shape == (32,) and np.isfinite(logits).all()
+
+
+class _FlakyDrainClient:
+    """fail_task fails per script; records the attempted tasks."""
+
+    def __init__(self, outcomes):
+        self.outcomes = list(outcomes)  # True = ok, Exception = raise
+        self.attempted = []
+        self.left = False
+
+    def fail_task(self, task):
+        self.attempted.append(task)
+        out = self.outcomes.pop(0) if self.outcomes else True
+        if isinstance(out, Exception):
+            raise out
+
+    def leave(self):
+        self.left = True
+
+
+def _drain_worker(tmp_path, client, shards):
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime import ElasticConfig
+    from edl_tpu.runtime.multihost import MultiHostWorker
+
+    w = MultiHostWorker(
+        fit_a_line.MODEL, client, source=None,
+        config=ElasticConfig(checkpoint_dir=str(tmp_path / "ck")),
+    )
+    w._uncommitted = list(shards)
+    return w
+
+
+def test_graceful_leave_continues_past_transient_failure(tmp_path):
+    from edl_tpu.coordinator import CoordinatorError
+
+    client = _FlakyDrainClient([True, CoordinatorError("blip"), True, True])
+    w = _drain_worker(tmp_path, client, ["s0", "s1", "s2", "s3"])
+    with pytest.raises(SystemExit):
+        w._graceful_leave()
+    # one transient hiccup must not abandon the remaining requeues
+    assert client.attempted == ["s0", "s1", "s2", "s3"]
+    assert client.left
+    assert w._uncommitted == []
+
+
+def test_graceful_leave_stops_when_coordinator_gone(tmp_path):
+    from edl_tpu.coordinator import CoordinatorError
+
+    client = _FlakyDrainClient(
+        [CoordinatorError("down"), CoordinatorError("down"), True]
+    )
+    w = _drain_worker(tmp_path, client, ["s0", "s1", "s2", "s3"])
+    with pytest.raises(SystemExit):
+        w._graceful_leave()
+    # two consecutive failures = coordinator gone; stop burning the pod's
+    # termination grace on reconnect timeouts (TTL expiry covers the rest)
+    assert client.attempted == ["s0", "s1"]
