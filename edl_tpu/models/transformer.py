@@ -35,7 +35,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass
 from functools import partial
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +44,9 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from edl_tpu.models.base import Model
-from edl_tpu.parallel.pipeline import _pipeline_local
+from edl_tpu.parallel.pipeline import _pipeline_local, pipeline_train_1f1b
 from edl_tpu.parallel.ring_attention import _ring_attention_local
+from edl_tpu.parallel.sharding import present_axes
 
 
 @dataclass(frozen=True)
@@ -56,12 +57,19 @@ class TransformerConfig:
     n_heads: int = 8
     d_ff: int = 2048
     seq_len: int = 1024
-    batch_axis: str = "data"
+    #: one mesh axis or a hierarchy (e.g. ("dcn", "data") for multi-slice
+    #: data parallelism — gradient reductions then ride DCN, everything
+    #: else stays on ICI; see parallel.mesh.build_hierarchical_mesh)
+    batch_axis: Union[str, Tuple[str, ...]] = "data"
     seq_axis: str = "seq"
     tp_axis: str = "model"
     pp_axis: str = "pipe"
     #: microbatches for the pipeline schedule; None = stage count.
     microbatches: Optional[int] = None
+    #: "gpipe" (default: autodiff through the forward schedule, O(M)
+    #: activation stash) or "1f1b" (combined fwd/bwd scan, O(pp) stash —
+    #: see edl_tpu.parallel.pipeline docstring for the schedule economics).
+    pipeline_schedule: str = "gpipe"
     #: per-block rematerialization (`jax.checkpoint` around each block under
     #: the scan): the backward pass recomputes block activations instead of
     #: storing them, cutting live activation memory from O(n_layers) to O(1)
@@ -140,6 +148,11 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
         raise ValueError(
             f"n_layers={cfg.n_layers} must be divisible by "
             f"pp={_axis_size(mesh, cfg.pp_axis)}"
+        )
+    if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown pipeline_schedule {cfg.pipeline_schedule!r}; "
+            "expected 'gpipe' or '1f1b'"
         )
     D, H, Dh, F, L, V = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
@@ -233,32 +246,51 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
         )
         return h
 
+    def tail_loss(lnf, head, y, tgt):
+        """Final norm + LM head + mean token cross-entropy (f32)."""
+        h = _rmsnorm(y, lnf).astype(jnp.float32)
+        logits = jnp.einsum("bsd,dv->bsv", h, head)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - gold)
+
     n_pp = _axis_size(mesh, cfg.pp_axis)
-    if n_pp > 1:
-        x = _pipeline_local(
+    if n_pp > 1 and cfg.pipeline_schedule == "1f1b":
+        # Combined-schedule pipeline: per-microbatch tail loss inside the
+        # scan (the seed cotangent must exist while later microbatches are
+        # still in forward — that interleaving is what bounds the
+        # activation stash at O(pp); see parallel.pipeline).
+        loss = pipeline_train_1f1b(
             stage,
+            lambda tp, y, tgt: tail_loss(tp[0], tp[1], y, tgt),
+            cfg.pp_axis,
+            n_pp,
+            cfg.microbatches or n_pp,
             params["blocks"],
+            (params["lnf"], params["head"]),
             x,
-            pipe_axis=cfg.pp_axis,
-            n_stages=n_pp,
-            microbatches=cfg.microbatches or n_pp,
+            targets,
         )
     else:
-        x = stage(params["blocks"], x)
-
-    h = _rmsnorm(x, params["lnf"]).astype(jnp.float32)
-    logits = jnp.einsum("bsd,dv->bsv", h, params["head"])  # (Bl, Sl, V) f32
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    loss = jnp.mean(lse - gold)
-    reduce_axes = tuple(
-        a for a in (cfg.batch_axis, cfg.seq_axis) if a in mesh.axis_names
-    )
+        if n_pp > 1:
+            x = _pipeline_local(
+                stage,
+                params["blocks"],
+                x,
+                pipe_axis=cfg.pp_axis,
+                n_stages=n_pp,
+                microbatches=cfg.microbatches or n_pp,
+            )
+        else:
+            x = stage(params["blocks"], x)
+        loss = tail_loss(params["lnf"], params["head"], x, targets)
+    reduce_axes = (*present_axes(mesh, cfg.batch_axis),
+                   *present_axes(mesh, cfg.seq_axis))
     return jax.lax.pmean(loss, reduce_axes) if reduce_axes else loss
 
 
 def _batch_specs(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
-    dp = cfg.batch_axis if cfg.batch_axis in mesh.axis_names else None
+    dp = present_axes(mesh, cfg.batch_axis) or None  # P takes the tuple
     sp = cfg.seq_axis if cfg.seq_axis in mesh.axis_names else None
     return {"tokens": P(dp, sp), "targets": P(dp, sp)}
 

@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pipe`` axis.
+"""Pipeline parallelism: microbatch schedules over a ``pipe`` mesh axis.
 
 The reference has no pipeline parallelism (SURVEY §2.3) — its distribution is
 data-parallel PS only — but a TPU framework schedules models too big for one
@@ -7,13 +7,38 @@ chip's HBM, so stages are first-class here. Design:
 - Stage parameters are a pytree whose LEADING dim is the stage index, sharded
   over the ``pipe`` mesh axis: each device holds one stage's weights (for a
   transformer, its contiguous chunk of layers).
-- The schedule is the classic (microbatches + stages - 1)-tick loop: at tick
-  ``t`` stage ``r`` processes microbatch ``t - r``; activations hop one ICI
-  neighbor per tick via `jax.lax.ppermute`. Warmup/drain bubble ticks compute
-  on garbage that is masked out of the output and carries zero cotangent, so
-  the whole schedule is differentiable through `jax.lax.scan`.
+- Activations hop one ICI neighbor per tick via `jax.lax.ppermute`;
+  warmup/drain bubble ticks compute on garbage that is masked out, so the
+  schedules stay jit-compilable with static shapes.
 - Stage outputs must have the stage-input shape (the standard homogeneous-
   stage restriction; residual-stream models satisfy it by construction).
+
+Two schedules:
+
+- **GPipe** (`_pipeline_local`): the classic (M + n - 1)-tick forward loop,
+  differentiated by autodiff — backward replays the reversed schedule. The
+  activation stash grows O(M) per stage (every microbatch's stage input is
+  saved for the backward scan).
+- **1F1B** (`pipeline_train_1f1b`): forward AND backward interleave in ONE
+  scan — each tick runs stage ``r``'s forward of microbatch ``t - r`` and
+  its backward of microbatch ``t - 2(n-1) + r``, with a cotangent hop riding
+  `ppermute` in the reverse direction. Because backward consumes activations
+  while forward produces them, the stash is a ring buffer of at most
+  ``min(M, 2n - 1)`` microbatch inputs — O(n), independent of M. That is the
+  1F1B memory property, and it is only reachable as a combined schedule:
+  autodiff of any forward-only scan must first finish all M forwards
+  (activations O(M)) before its reverse pass, so the construct computes loss
+  and all gradients in its forward rule (`jax.custom_vjp`; the vjp just
+  scales the stashed grads by the upstream cotangent).
+
+Schedule economics on TPU (honest accounting, `bubble_fraction`): XLA's
+static schedule executes masked bubble ticks at full cost, so the combined
+1F1B scan runs ``M + 2(n-1)`` ticks of (fwd+bwd) work vs GPipe's effective
+``M + n - 1``; per-step wall time therefore favors GPipe at equal M, and
+1F1B's win is HBM headroom — it admits a much larger M (smaller bubble
+fraction, better lease-granularity) at fixed activation memory, where GPipe
+would OOM. Default stays GPipe; flip `TransformerConfig.pipeline_schedule`
+to "1f1b" when activation memory binds.
 
 `_pipeline_local` is the inside-a-shard_map form (composable with tensor and
 sequence parallelism — the transformer calls it with ring attention inside the
@@ -29,6 +54,21 @@ import jax
 import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def bubble_fraction(schedule: str, n_stages: int, microbatches: int) -> float:
+    """Fraction of stage executions that are masked warmup/drain garbage
+    (XLA executes them at full cost — this is wasted wall-clock, not just
+    idle time). GPipe: (n-1)/(M+n-1) in each of the forward and backward
+    scans. 1F1B combined scan: 2(n-1)/(M+2(n-1)) of its fwd+bwd ticks."""
+    n, m = n_stages, microbatches
+    if n <= 1:
+        return 0.0
+    if schedule == "gpipe":
+        return (n - 1) / (m + n - 1)
+    if schedule == "1f1b":
+        return 2 * (n - 1) / (m + 2 * (n - 1))
+    raise ValueError(f"unknown schedule {schedule!r}")
 
 
 def _pipeline_local(
@@ -126,3 +166,187 @@ def pipeline_apply(
         out_specs=x_spec,
         check_vma=False,
     )(stage_params, x)
+
+
+# -- 1F1B: combined forward/backward schedule ----------------------------------
+
+
+def _tree_where(cond, a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(cond, x, y), a, b
+    )
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def _tree_zeros(t):
+    return jax.tree_util.tree_map(jnp.zeros_like, t)
+
+
+def _tree_scale(t, s):
+    return jax.tree_util.tree_map(lambda x: (x * s).astype(x.dtype), t)
+
+
+def _run_1f1b(stage_fn, tail_fn, pipe_axis, n_stages, microbatches,
+              stage_params, tail_params, x, aux):
+    """The combined schedule (see module docstring). Local to a shard_map.
+
+    Returns ``(loss, (d_stage, d_tail, dx))`` where loss/d_tail/dx are
+    pipe-replicated (psum-assembled) and d_stage is this rank's stage
+    gradient. All gradients already carry the 1/M mean weighting.
+    """
+    n, M = n_stages, microbatches
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"local batch {B} must be divisible by microbatches {M}")
+    mb = x.reshape((M, B // M) + x.shape[1:])
+    aux_mb = jax.tree_util.tree_map(
+        lambda a: a.reshape((M, B // M) + a.shape[1:]), aux
+    )
+    r = jax.lax.axis_index(pipe_axis)
+    fwd_pairs = [(i, i + 1) for i in range(n - 1)]
+    bwd_pairs = [(i + 1, i) for i in range(n - 1)]
+    n_slots = min(M, 2 * n - 1)  # max in-flight microbatches per stage
+
+    def stage_vjp(a, g):
+        """Recompute-forward vjp of one stage application (remat-style:
+        only the stage INPUT is stashed)."""
+        _, vjp = jax.vjp(lambda p, a_: stage_fn(p, a_), stage_params, a)
+        return vjp(g)  # (d_params, d_input)
+
+    def tail_grad(y, av):
+        """Per-microbatch loss + seed cotangent at the last stage."""
+        loss, vjp = jax.vjp(
+            lambda tp, y_: tail_fn(tp, y_, av), tail_params, y
+        )
+        d_tail, g = vjp(jnp.ones_like(loss))
+        return loss, d_tail, g
+
+    def tick(carry, t):
+        (fwd_hop, bwd_hop, act_buf, d_stage, d_tail, dx_grid, loss_acc) = carry
+
+        # ---- F-phase: stage r runs forward of microbatch t - r ----
+        fm = t - r
+        valid_f = (fm >= 0) & (fm < M)
+        fmc = jnp.clip(fm, 0, M - 1)
+        inp = jnp.where(r == 0, mb[fmc], fwd_hop)
+        y = stage_fn(stage_params, inp)
+        # stash the stage input for this microbatch's backward
+        slot_f = fmc % n_slots
+        prev = jax.lax.dynamic_index_in_dim(act_buf, slot_f, 0, keepdims=False)
+        act_buf = jax.lax.dynamic_update_index_in_dim(
+            act_buf, jnp.where(valid_f, inp, prev), slot_f, 0
+        )
+
+        # ---- B-phase: stage r runs backward of microbatch t - 2(n-1) + r.
+        # At the last stage that is exactly this tick's forward microbatch,
+        # so its tail cotangent seeds from the y just computed.
+        bm = t - 2 * (n - 1) + r
+        valid_b = (bm >= 0) & (bm < M)
+        bmc = jnp.clip(bm, 0, M - 1)
+        loss_mb, d_tail_mb, g_tail = tail_grad(
+            y, jax.tree_util.tree_map(lambda a: a[bmc], aux_mb)
+        )
+        g = jnp.where(r == n - 1, g_tail, bwd_hop).astype(y.dtype)
+        a_saved = jax.lax.dynamic_index_in_dim(
+            act_buf, bmc % n_slots, 0, keepdims=False
+        )
+        d_p, d_a = stage_vjp(a_saved, g)
+        d_stage = _tree_add(d_stage, _tree_where(valid_b, d_p, _tree_zeros(d_p)))
+        last_valid = valid_b & (r == n - 1)
+        d_tail = _tree_add(
+            d_tail, _tree_where(last_valid, d_tail_mb, _tree_zeros(d_tail_mb))
+        )
+        loss_acc = loss_acc + jnp.where(last_valid, loss_mb, 0.0)
+        prev_dx = jax.lax.dynamic_index_in_dim(dx_grid, bmc, 0, keepdims=False)
+        dx_grid = jax.lax.dynamic_update_index_in_dim(
+            dx_grid, jnp.where(valid_b & (r == 0), d_a, prev_dx), bmc, 0
+        )
+
+        # ---- hops: activations to r+1, cotangents to r-1 ----
+        fwd_hop = jax.lax.ppermute(y, pipe_axis, fwd_pairs)
+        bwd_hop = jax.lax.ppermute(d_a, pipe_axis, bwd_pairs)
+        return (fwd_hop, bwd_hop, act_buf, d_stage, d_tail, dx_grid,
+                loss_acc), None
+
+    carry0 = (
+        jnp.zeros_like(mb[0]),                       # fwd activation hop
+        jnp.zeros_like(mb[0]),                       # bwd cotangent hop
+        jnp.zeros((n_slots,) + mb.shape[1:], mb.dtype),  # input ring buffer
+        _tree_zeros(stage_params),
+        _tree_zeros(tail_params),
+        jnp.zeros_like(mb),                          # dx per microbatch
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, _, d_stage, d_tail, dx_grid, loss_acc), _ = jax.lax.scan(
+        tick, carry0, jnp.arange(M + 2 * (n - 1))
+    )
+
+    inv_m = 1.0 / M
+    is_last = (r == n - 1).astype(jnp.float32)
+    # loss and tail grads live only on the last stage; dx only on stage 0:
+    # psum re-replicates them across the pipe axis (zeros elsewhere).
+    loss = jax.lax.psum(loss_acc * is_last, pipe_axis) * inv_m
+    d_tail = jax.tree_util.tree_map(
+        lambda v: jax.lax.psum(
+            (v * is_last.astype(v.dtype)).astype(v.dtype), pipe_axis
+        ) * jnp.asarray(inv_m, v.dtype),
+        d_tail,
+    )
+    # dx stays NONZERO ONLY ON STAGE 0 — the same per-device cotangent
+    # pattern autodiff of the GPipe local program produces (x is consumed
+    # through `where(r == 0, ...)` there too). The enclosing shard_map
+    # transpose reconciles replicated-input cotangents from that pattern;
+    # replicating dx across the pipe axis here would double-count.
+    dx = (jnp.where(r == 0, dx_grid, 0) * jnp.asarray(inv_m, dx_grid.dtype))
+    dx = dx.astype(x.dtype).reshape((B,) + x.shape[1:])
+    d_stage = _tree_scale(d_stage, inv_m)
+    return loss, (d_stage, d_tail, dx)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def pipeline_train_1f1b(stage_fn, tail_fn, pipe_axis, n_stages, microbatches,
+                        stage_params, tail_params, x, aux):
+    """1F1B training pipeline: mean over microbatches of
+    ``tail_fn(tail_params, stage_chain(x_m), aux_m)``.
+
+    Call inside a shard_map whose manual axes include ``pipe_axis``.
+    ``aux`` is a non-differentiated pytree of per-example arrays (targets,
+    masks) microbatched alongside ``x``. The loss it returns is
+    differentiable w.r.t. ``stage_params``/``tail_params``/``x`` — but the
+    gradients were already computed by the combined schedule in the forward
+    pass (that is the point: fwd and bwd interleave in one scan, bounding
+    the activation stash at O(n_stages)); the vjp rule just scales them by
+    the upstream cotangent. Calling this without differentiating it wastes
+    the backward work — use the GPipe path for inference.
+    """
+    loss, _ = _run_1f1b(stage_fn, tail_fn, pipe_axis, n_stages, microbatches,
+                        stage_params, tail_params, x, aux)
+    return loss
+
+
+def _1f1b_fwd(stage_fn, tail_fn, pipe_axis, n_stages, microbatches,
+              stage_params, tail_params, x, aux):
+    loss, grads = _run_1f1b(stage_fn, tail_fn, pipe_axis, n_stages,
+                            microbatches, stage_params, tail_params, x, aux)
+    return loss, grads
+
+
+def _1f1b_bwd(stage_fn, tail_fn, pipe_axis, n_stages, microbatches, res, ct):
+    d_stage, d_tail, dx = res
+    # The construct's forward ends in a psum over the pipe axis (the loss
+    # broadcast); a true vjp would therefore deliver the SUM of all ranks'
+    # upstream cotangents to the stashed gradients. The enclosing shard_map
+    # splits a replicated output's cotangent 1/n_pipe per rank, so
+    # short-circuiting with the raw per-rank ct would shrink every grad by
+    # n_pipe. Emulate the psum transpose for the grads the machinery reads
+    # per-rank (stage shards; stage-0's dx) — but NOT for d_tail, whose
+    # replicated in_spec the machinery itself sums over the pipe axis.
+    ct_sum = jax.lax.psum(ct, pipe_axis)
+    return (_tree_scale(d_stage, ct_sum), _tree_scale(d_tail, ct),
+            (dx * ct_sum).astype(dx.dtype), None)
+
+
+pipeline_train_1f1b.defvjp(_1f1b_fwd, _1f1b_bwd)

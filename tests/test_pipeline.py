@@ -80,3 +80,113 @@ def test_no_pipe_axis_runs_all_stages():
     got = pipeline_apply(_stage_fn, params, x, mesh)
     want = _sequential(params, x, 4)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
+
+def test_bubble_fraction_accounting():
+    from edl_tpu.parallel.pipeline import bubble_fraction
+
+    assert bubble_fraction("gpipe", 1, 4) == 0.0
+    assert bubble_fraction("gpipe", 4, 4) == pytest.approx(3 / 7)
+    assert bubble_fraction("1f1b", 4, 4) == pytest.approx(6 / 10)
+    # 1f1b's bubble shrinks with M while its memory stays O(n) — the regime
+    # the schedule exists for
+    assert bubble_fraction("1f1b", 4, 32) < bubble_fraction("1f1b", 4, 8)
+    with pytest.raises(ValueError):
+        bubble_fraction("interleaved", 4, 4)
+
+
+@pytest.mark.parametrize(
+    "axes,microbatches",
+    [({"pipe": 2, "data": 4}, 4), ({"pipe": 4, "data": 2}, 8)],
+    ids=["pp2-M4", "pp4-M8"],
+)
+def test_1f1b_matches_gpipe_in_model(axes, microbatches):
+    """Schedule choice must change memory/wall profile, not math: loss AND
+    every gradient (stage, tail, embedding via dx) equal to reassociation
+    tolerance between gpipe and the combined-scan 1f1b."""
+    import dataclasses
+
+    from edl_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=8, d_ff=64,
+        seq_len=16, microbatches=microbatches,
+    )
+    mesh = build_mesh(MeshSpec(axes))
+    gpipe = transformer.make_model(cfg)
+    onef1b = transformer.make_model(
+        dataclasses.replace(cfg, pipeline_schedule="1f1b")
+    )
+    params = gpipe.init(jax.random.PRNGKey(0), mesh)
+    batch = gpipe.synthetic_batch(np.random.default_rng(0), 16)
+    placed = {
+        k: jax.device_put(
+            jnp.asarray(v),
+            jax.sharding.NamedSharding(mesh, gpipe.batch_spec(mesh)[k]),
+        )
+        for k, v in batch.items()
+    }
+
+    def run(model):
+        fn = jax.jit(jax.value_and_grad(
+            lambda p, b: model.loss_fn(p, b, mesh)
+        ))
+        loss, grads = fn(params, placed)
+        return float(loss), grads
+
+    l_g, g_g = run(gpipe)
+    l_1, g_1 = run(onef1b)
+    assert l_g == pytest.approx(l_1, rel=1e-5)
+    flat_g, _ = jax.tree_util.tree_flatten_with_path(g_g)
+    flat_1 = jax.tree_util.tree_leaves(g_1)
+    for (path, a), b in zip(flat_g, flat_1):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=5e-2, atol=2e-5,
+            err_msg=str(path),
+        )
+
+
+def test_1f1b_matches_single_device_oracle():
+    """1f1b on a pipe mesh vs the same model on one device: the schedule
+    must be invisible to the optimizer."""
+    import dataclasses
+
+    from edl_tpu.models import transformer
+
+    cfg = transformer.TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=8, d_ff=64,
+        seq_len=16,
+    )
+    batch = transformer.synthetic_batch(cfg, np.random.default_rng(0), 8)
+
+    def loss_on(axes, schedule):
+        n_dev = 1
+        for v in axes.values():
+            n_dev *= v
+        mesh = build_mesh(MeshSpec(axes), jax.devices()[:n_dev])
+        model = transformer.make_model(
+            dataclasses.replace(cfg, pipeline_schedule=schedule)
+        )
+        params = model.init(jax.random.PRNGKey(0), mesh)
+        placed = {
+            k: jax.device_put(
+                jnp.asarray(v),
+                jax.sharding.NamedSharding(mesh, model.batch_spec(mesh)[k]),
+            )
+            for k, v in batch.items()
+        }
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p, b: model.loss_fn(p, b, mesh)
+        ))(params, placed)
+        return float(loss), grads
+
+    l_ref, g_ref = loss_on({"data": 1}, "gpipe")
+    l_pp, g_pp = loss_on({"pipe": 4, "data": 2}, "1f1b")
+    assert l_pp == pytest.approx(l_ref, rel=2e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_pp)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=8e-2, atol=3e-4,
+        )

@@ -154,3 +154,13 @@ def test_flash_attention_matches_dense_in_model():
                     jax.tree_util.tree_leaves(gd)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=3e-2, atol=3e-3)
+
+
+def test_unknown_pipeline_schedule_raises():
+    mesh = build_mesh(MeshSpec({"pipe": 2, "data": 4}))
+    import dataclasses
+
+    bad = dataclasses.replace(CFG, n_layers=2, pipeline_schedule="1F1B ")
+    model = transformer.make_model(bad)
+    with pytest.raises(ValueError, match="pipeline_schedule"):
+        model.init(jax.random.PRNGKey(0), mesh)
