@@ -15,7 +15,9 @@ data plane (`paddle pserver` C++, sparse port pools `pkg/jobparser.go:232-247`,
   sharding annotations: same train step, any mesh.
 """
 
-from edl_tpu.parallel.mesh import MeshSpec, build_mesh, local_mesh
+from edl_tpu.parallel.mesh import (
+    MeshSpec, build_hierarchical_mesh, build_mesh, local_mesh,
+)
 from edl_tpu.parallel.sharding import (
     batch_sharding,
     named_sharding,
@@ -30,6 +32,7 @@ __all__ = [
     "MeshSpec",
     "ShardedEmbedding",
     "batch_sharding",
+    "build_hierarchical_mesh",
     "build_mesh",
     "dense_attention",
     "local_mesh",

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -110,7 +110,9 @@ class ShardedEmbedding:
     vocab_size: int
     features: int
     shard_axis: str = "data"
-    batch_axis: str = "data"
+    #: one mesh axis or a hierarchy tuple (e.g. ("dcn", "data")) the
+    #: ids/batches are sharded over
+    batch_axis: Any = "data"
     dtype: jnp.dtype = jnp.float32
 
     #: vocab is padded to a multiple of this REGARDLESS of mesh size, so the
@@ -188,8 +190,12 @@ class ShardedEmbedding:
         )(table, flat_ids)
 
     def _lookup_cross_axis(self, mesh: Mesh, table: jax.Array, flat_ids: jax.Array):
-        shard_ax, batch_ax = self.shard_axis, self.batch_axis
-        batch_spec = P(batch_ax) if batch_ax in mesh.axis_names else P()
+        from edl_tpu.parallel.sharding import present_axes
+
+        shard_ax = self.shard_axis
+        have = present_axes(mesh, self.batch_axis)
+        batch_ax = have or None  # P accepts the axis tuple directly
+        batch_spec = P(batch_ax) if have else P()
 
         def kernel(table_local: jax.Array, ids_local: jax.Array):
             local_rows = table_local.shape[0]
@@ -200,7 +206,7 @@ class ShardedEmbedding:
             contrib = jnp.where(hit[:, None], table_local[safe], 0)
             return jax.lax.psum(contrib, shard_ax)
 
-        out_spec = P(batch_ax, None) if batch_ax in mesh.axis_names else P(None, None)
+        out_spec = P(batch_ax, None) if have else P(None, None)
         return shard_map(
             kernel,
             mesh=mesh,

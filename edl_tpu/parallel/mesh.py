@@ -27,7 +27,13 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("data", "seq", "expert", "model")
+#: "dcn" is outermost by construction: it is the only axis whose collectives
+#: may cross the data-center network (once-per-step, bandwidth-tolerant
+#: gradient reductions). Every other axis — model/seq tensor collectives,
+#: pipeline ppermutes, expert gathers — is latency-critical and stays inside
+#: one ICI slice, which `build_hierarchical_mesh` guarantees by construction
+#: (inner axes never straddle a slice boundary).
+AXIS_ORDER = ("dcn", "data", "seq", "expert", "model")
 
 
 @dataclass(frozen=True)
@@ -128,6 +134,66 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None) -
     shape = [spec.axis(n) for n in names]
     mesh_devices = arrange_devices(devs, shape)
     return Mesh(mesh_devices, axis_names=tuple(names))
+
+
+def build_hierarchical_mesh(
+    spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Two-tier mesh for multi-slice jobs: the ``dcn`` axis spans slices,
+    every other axis stays inside one slice's ICI domain.
+
+    The scaling-book multi-pod recipe: data-parallel gradient reductions
+    (one bandwidth-tolerant psum per step) ride DCN across slices, while
+    tensor/sequence/pipeline collectives — latency-critical, many per
+    layer — get ICI neighbors. XLA lowers a psum over ("dcn", "data") to
+    the hierarchical reduce (intra-slice reduce-scatter, inter-slice
+    all-reduce, intra-slice all-gather) on real hardware.
+
+    Slice identity: real TPU slices expose ``device.slice_index``;
+    multi-host simulations group by ``process_index``; a single-process
+    virtual mesh (tests, the driver dry run) splits the sorted device list
+    evenly — the dcn axis is then topologically fictional but compiles the
+    identical program (that is the point of the dry run).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n_slices = spec.axis("dcn")
+    if n_slices <= 1:
+        return build_mesh(spec, devs)
+    if spec.size() != len(devs):
+        raise ValueError(
+            f"mesh spec {spec.axes} needs {spec.size()} devices, have {len(devs)}"
+        )
+
+    def slice_id(d):
+        s = getattr(d, "slice_index", None)
+        return s if s is not None else getattr(d, "process_index", 0)
+
+    groups: Dict[int, list] = {}
+    for d in devs:
+        groups.setdefault(slice_id(d), []).append(d)
+    if len(groups) == n_slices:
+        slices = [groups[k] for k in sorted(groups)]
+        if len({len(s) for s in slices}) != 1:
+            raise ValueError(
+                f"uneven slices: {[len(s) for s in slices]} devices per slice"
+            )
+    elif len(groups) == 1:
+        # virtual single-process mesh: split evenly in stable id order
+        ordered = sorted(devs, key=lambda d: getattr(d, "id", 0))
+        per = len(devs) // n_slices
+        slices = [ordered[i * per:(i + 1) * per] for i in range(n_slices)]
+    else:
+        raise ValueError(
+            f"dcn={n_slices} but devices form {len(groups)} slice groups"
+        )
+
+    inner = MeshSpec({k: v for k, v in spec.axes.items() if k != "dcn"})
+    inner_names = inner.ordered_axes() or ["data"]
+    inner_shape = [inner.axis(n) for n in inner_names]
+    stacked = np.stack(
+        [arrange_devices(s, inner_shape) for s in slices]
+    )  # (dcn, *inner)
+    return Mesh(stacked, axis_names=("dcn", *inner_names))
 
 
 def local_mesh(axes: Optional[Dict[str, int]] = None) -> Mesh:

@@ -2,11 +2,29 @@
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: a batch axis is one mesh axis or a hierarchy of them (e.g.
+#: ("dcn", "data") for multi-slice data parallelism — see parallel.mesh)
+BatchAxis = Union[str, Sequence[str]]
+
+
+def present_axes(mesh: Mesh, axis: BatchAxis) -> Tuple[str, ...]:
+    """The subset of ``axis`` (str or sequence) present on ``mesh``."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    return tuple(a for a in names if a in mesh.axis_names)
+
+
+def axis_size(mesh: Mesh, axis: BatchAxis) -> int:
+    """Product of the present axes' sizes (1 when none present)."""
+    n = 1
+    for a in present_axes(mesh, axis):
+        n *= mesh.shape[a]
+    return n
 
 
 def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
@@ -17,17 +35,19 @@ def replicate(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Leading-dim batch sharding over the data axis (absent axis: replicate)."""
-    if axis in mesh.axis_names:
-        return NamedSharding(mesh, P(axis))
+def batch_sharding(mesh: Mesh, axis: BatchAxis = "data") -> NamedSharding:
+    """Leading-dim batch sharding over the data axis or axis hierarchy
+    (absent axes drop out; none present: replicate)."""
+    have = present_axes(mesh, axis)
+    if have:
+        return NamedSharding(mesh, P(have))  # P accepts a 1-tuple entry
     return replicate(mesh)
 
 
 def shard_batch(
     batch: Any,
     mesh: Mesh,
-    axis: str = "data",
+    axis: BatchAxis = "data",
     specs: Optional[Any] = None,
 ):
     """Place a host-side batch pytree onto the mesh.
@@ -78,5 +98,5 @@ def shard_batch(
     return jax.device_put(host_batch, batch_sharding(mesh, axis))
 
 
-def global_batch_size(local_batch: int, mesh: Mesh, axis: str = "data") -> int:
-    return local_batch * (mesh.shape[axis] if axis in mesh.axis_names else 1)
+def global_batch_size(local_batch: int, mesh: Mesh, axis: BatchAxis = "data") -> int:
+    return local_batch * axis_size(mesh, axis)

@@ -39,7 +39,9 @@ class TrainerConfig:
     optimizer: str = "adam"  # "adam" | "sgd" | "adagrad" (ref CTR uses adagrad-ish SGD)
     momentum: float = 0.0
     grad_clip_norm: float = 0.0
-    batch_axis: str = "data"
+    #: one mesh axis or a hierarchy tuple (("dcn", "data") for multi-slice
+    #: data parallelism; see parallel.mesh.build_hierarchical_mesh)
+    batch_axis: Any = "data"
     seed: int = 0
     #: compact host->device batch transport (bf16 floats, u8/u24 ints; see
     #: edl_tpu.runtime.wire). Decode happens inside the jitted step.
@@ -147,10 +149,12 @@ class Trainer:
         sharding (moments of sharded params) and scalars are untouched."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        axis = self.config.batch_axis
-        if axis not in self.mesh.axis_names:
+        from edl_tpu.parallel.sharding import axis_size, present_axes
+
+        axis = present_axes(self.mesh, self.config.batch_axis)
+        if not axis:
             return opt_state
-        n = self.mesh.shape[axis]
+        n = axis_size(self.mesh, axis)
 
         def target_sharding(x):
             """New sharding for leaves that should reshard; None otherwise.
