@@ -168,6 +168,13 @@ class TrainingJobSpec:
     #: steps between async checkpoints (also taken on rescale signals).
     checkpoint_interval: int = 1000
     checkpoint_dir: str = ""
+    #: per-job coordinator secret (EDL_COORD_TOKEN): the updater generates
+    #: one at admission when empty, and every pod of the job gets it via
+    #: make_env — so the 0.0.0.0-bound coordinator rejects other jobs'
+    #: (or strangers') pods. Stored in the spec, the in-tree stand-in for
+    #: projecting a K8s Secret; the reference's etcd sidecar had no auth
+    #: at all (pkg/jobparser.go:167-184).
+    auth_token: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "TrainingJobSpec":
@@ -184,6 +191,7 @@ class TrainingJobSpec:
             data_shards=list(d.get("data_shards", [])),
             checkpoint_interval=int(d.get("checkpoint_interval", 1000)),
             checkpoint_dir=d.get("checkpoint_dir", ""),
+            auth_token=d.get("auth_token", ""),
         )
 
     def to_dict(self) -> dict:
@@ -199,6 +207,7 @@ class TrainingJobSpec:
             "data_shards": list(self.data_shards),
             "checkpoint_interval": self.checkpoint_interval,
             "checkpoint_dir": self.checkpoint_dir,
+            "auth_token": self.auth_token,
         }
 
 

@@ -49,33 +49,46 @@ class CoordinatorActuator:
         self._lock = threading.Lock()
         self._endpoints: Dict[str, Tuple[str, int]] = {}
         self._backoff_until: Dict[str, float] = {}
+        #: per-job coordinator secrets (spec.auth_token): the controller's
+        #: own writes must authenticate like any pod's, or every rescale
+        #: publish/nudge would be rejected the moment a job has auth on.
+        self._tokens: Dict[str, str] = {}
 
     # -- endpoint registry -----------------------------------------------------
 
     def track(self, job: TrainingJob) -> None:
         """Derive the job's coordinator endpoint from its spec (the stable
-        service DNS name the pods themselves dial)."""
+        service DNS name the pods themselves dial) and record its auth
+        token (the updater may mint it after the first track call, so the
+        token refreshes on every call even though the endpoint is sticky)."""
         host, _, port = coordinator_endpoint(job).rpartition(":")
         with self._lock:
             # An explicit endpoint (set_endpoint) wins over the derived one:
             # tests and local pools register the real host:port first.
             self._endpoints.setdefault(job.name, (host, int(port)))
+            if job.spec.auth_token:
+                self._tokens[job.name] = job.spec.auth_token
 
-    def set_endpoint(self, job_name: str, host: str, port: int) -> None:
+    def set_endpoint(self, job_name: str, host: str, port: int,
+                     token: str = "") -> None:
         with self._lock:
             self._endpoints[job_name] = (host, int(port))
+            if token:
+                self._tokens[job_name] = token
 
     def forget(self, job_name: str) -> None:
         with self._lock:
             self._endpoints.pop(job_name, None)
             # a re-created same-name job must not inherit this backoff
             self._backoff_until.pop(job_name, None)
+            self._tokens.pop(job_name, None)
 
     def _dial(self, job_name: str, force: bool = False):
         import time
 
         with self._lock:
             endpoint = self._endpoints.get(job_name)
+            token = self._tokens.get(job_name, "")
             if endpoint is None:
                 return None
             if (not force
@@ -88,6 +101,7 @@ class CoordinatorActuator:
                 host=endpoint[0], port=endpoint[1],
                 worker=f"controller/{job_name}",
                 connect_timeout=self.dial_timeout,
+                token=token,
             )
         except Exception:
             with self._lock:

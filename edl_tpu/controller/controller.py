@@ -157,6 +157,10 @@ class Controller:
             return  # never admitted (e.g. rejected duplicate) — the
             # name-keyed autoscaler must not see its events
         updater.notify_update(job)
+        # Refresh the actuator's view too: the updater mints spec.auth_token
+        # AFTER admission (its store write echoes back as this update), and
+        # the actuator's dials must authenticate once the token exists.
+        self.actuator.track(job)
         self.autoscaler.on_update(job)
 
     def on_del(self, job: TrainingJob) -> None:

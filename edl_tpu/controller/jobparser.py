@@ -93,6 +93,11 @@ def make_env(job: TrainingJob, role: str) -> Dict[str, str]:
         # done-set out of a reused workspace volume.
         "EDL_RUN_ID": job.uid or f"{job.namespace}/{job.name}",
     }
+    if spec.auth_token:
+        # Per-job coordinator secret: the coordinator binary reads it at
+        # startup, CoordinatorClient attaches it to every call. Same value
+        # in every pod of the job by construction.
+        env["EDL_COORD_TOKEN"] = spec.auth_token
     replica: ReplicaSpec = spec.trainer if role == ROLE_TRAINER else spec.coordinator
     if replica.entrypoint:
         env["EDL_ENTRY"] = replica.entrypoint

@@ -164,6 +164,7 @@ class JobUpdater:
         a live job would double its resource footprint.
         """
         self._set_phase(JobPhase.CREATING)
+        self._ensure_auth_token()
         if not self.cluster.job_pods(self.job.name, ROLE_COORDINATOR):
             coord = parse_to_coordinator(self.job)
             self.cluster.create_role(
@@ -190,6 +191,24 @@ class JobUpdater:
             self.job.status.parallelism = trainer.replicas
         self._set_phase(JobPhase.RUNNING)
         return True
+
+    def _ensure_auth_token(self) -> None:
+        """Stamp a per-job coordinator secret into the spec at admission.
+
+        Persisted through the store BEFORE any pod materializes, so a
+        controller restart replays the same token instead of minting a new
+        one under running pods (which would lock every trainer out of its
+        own coordinator). Pods receive it as EDL_COORD_TOKEN (make_env).
+        """
+        if self.job.spec.auth_token:
+            return
+        import secrets
+
+        self.job.spec.auth_token = secrets.token_hex(16)
+        try:
+            self.job = normalize(self.store.update(self.job))
+        except KeyError:
+            pass  # job deleted from the store mid-flight; actor will exit
 
     def _coordinator_ready(self) -> bool:
         pods = self.cluster.job_pods(self.job.name, ROLE_COORDINATOR)

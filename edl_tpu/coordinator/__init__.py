@@ -13,12 +13,15 @@ etcd sidecar + pserver self-registration (SURVEY §2.2). Provides:
   `pkg/client/.../fake`).
 """
 
-from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
+from edl_tpu.coordinator.client import (
+    CoordinatorAuthError, CoordinatorClient, CoordinatorError,
+)
 from edl_tpu.coordinator.inprocess import InProcessCoordinator
 from edl_tpu.coordinator.server import CoordinatorServer
 
 __all__ = [
     "CoordinatorClient",
+    "CoordinatorAuthError",
     "CoordinatorError",
     "CoordinatorServer",
     "InProcessCoordinator",

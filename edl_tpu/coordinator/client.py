@@ -9,6 +9,7 @@ pod launcher's poll-and-sleep discovery (`docker/k8s_tools.py:70-78`).
 from __future__ import annotations
 
 import json
+import os
 import socket
 import time
 from typing import Dict, List, Optional
@@ -18,15 +19,32 @@ class CoordinatorError(RuntimeError):
     pass
 
 
+class CoordinatorAuthError(CoordinatorError):
+    """The coordinator rejected the call's token (job secret mismatch).
+
+    Typed separately because the right reaction differs from transport
+    errors: retrying cannot help — the pod's EDL_COORD_TOKEN disagrees
+    with the job's, which is a deployment bug (or an unauthorized peer).
+    """
+
+
 class CoordinatorClient:
     """One persistent connection; requests are serialized (1 req -> 1 reply),
-    except ``barrier`` which blocks until the coordinator releases it."""
+    except ``barrier`` which blocks until the coordinator releases it.
+
+    ``token`` is the per-job shared secret (default: the pod env's
+    EDL_COORD_TOKEN, stamped by the controller — jobparser.make_env); it
+    rides every request. Auth-rejected calls raise CoordinatorAuthError.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7164,
-                 worker: str = "", connect_timeout: float = 10.0):
+                 worker: str = "", connect_timeout: float = 10.0,
+                 token: Optional[str] = None):
         self.host = host
         self.port = port
         self.worker = worker
+        self.token = token if token is not None \
+            else os.environ.get("EDL_COORD_TOKEN", "")
         self._sock: Optional[socket.socket] = None
         self._buf = b""
         self._connect(connect_timeout)
@@ -73,6 +91,8 @@ class CoordinatorClient:
         req = {"op": op, **fields}
         if self.worker and "worker" not in req:
             req["worker"] = self.worker
+        if self.token and "token" not in req:
+            req["token"] = self.token
         payload = (json.dumps(req, ensure_ascii=False) + "\n").encode()
         self._sock.settimeout(timeout)
         try:
@@ -92,7 +112,12 @@ class CoordinatorClient:
             if self._sock is not None:
                 self._sock.settimeout(None)
         line, self._buf = self._buf.split(b"\n", 1)
-        return json.loads(line)
+        reply = json.loads(line)
+        if isinstance(reply, dict) and reply.get("unauthorized"):
+            raise CoordinatorAuthError(
+                f"coordinator rejected {op!r}: {reply.get('error', 'unauthorized')}"
+            )
+        return reply
 
     # -- membership ------------------------------------------------------------
 
@@ -152,6 +177,8 @@ class CoordinatorClient:
         """
         try:
             return self.call("barrier", timeout=timeout, name=name, count=count)
+        except CoordinatorAuthError:
+            raise  # deployment bug, not a timeout — never mask it
         except CoordinatorError:
             return {"ok": False, "error": "timeout"}
 
@@ -164,6 +191,8 @@ class CoordinatorClient:
         """
         try:
             return self.call("sync", timeout=timeout, epoch=int(epoch))
+        except CoordinatorAuthError:
+            raise  # deployment bug, not a timeout — never mask it
         except CoordinatorError:
             return {"ok": False, "error": "timeout"}
 

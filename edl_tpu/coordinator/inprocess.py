@@ -18,9 +18,15 @@ from typing import Dict, List, Optional, Set
 
 
 class InProcessCoordinator:
-    def __init__(self, task_lease_sec: float = 16.0, heartbeat_ttl_sec: float = 10.0):
+    def __init__(self, task_lease_sec: float = 16.0,
+                 heartbeat_ttl_sec: float = 10.0,
+                 auth_token: Optional[str] = None):
         self.task_lease_sec = task_lease_sec
         self.heartbeat_ttl_sec = heartbeat_ttl_sec
+        #: per-job shared secret, same contract as the native binary's
+        #: EDL_COORD_TOKEN: empty/None disables auth; set, every client op
+        #: except ping must present it (CoordinatorAuthError otherwise).
+        self.auth_token = auth_token or ""
         self._lock = threading.RLock()
         self._barrier_cv = threading.Condition(self._lock)
         self._epoch = 0
@@ -302,16 +308,40 @@ class InProcessCoordinator:
 
     # -- client-compatible facade ---------------------------------------------
 
-    def client(self, worker: str = "") -> "InProcessClient":
-        return InProcessClient(self, worker)
+    def client(self, worker: str = "",
+               token: Optional[str] = None) -> "InProcessClient":
+        # None = "use the coordinator's own token": the common single-
+        # process case (both ends in one pod share EDL_COORD_TOKEN).
+        # Tests pass an explicit wrong/empty token for the negative path.
+        return InProcessClient(
+            self, worker, self.auth_token if token is None else token
+        )
+
+    def authorize(self, token: str) -> None:
+        """The wire twin's auth gate (native: coordinator.cc handle())."""
+        if self.auth_token and token != self.auth_token:
+            from edl_tpu.coordinator.client import CoordinatorAuthError
+
+            raise CoordinatorAuthError(
+                "coordinator rejected call: bad or missing token"
+            )
 
 
 class InProcessClient:
-    """Same method surface as CoordinatorClient, bound to one worker name."""
+    """Same method surface as CoordinatorClient, bound to one worker name.
 
-    def __init__(self, coord: InProcessCoordinator, worker: str):
+    Auth mirrors the native wire: every op except ping passes through the
+    coordinator's token gate before touching state.
+    """
+
+    def __init__(self, coord: InProcessCoordinator, worker: str,
+                 token: str = ""):
         self._c = coord
         self.worker = worker
+        self.token = token
+
+    def _auth(self) -> None:
+        self._c.authorize(self.token)
 
     def close(self) -> None:
         pass
@@ -323,58 +353,76 @@ class InProcessClient:
         pass
 
     def register(self, takeover: bool = False):
+        self._auth()
         return self._c.register(self.worker, takeover=takeover)
 
     def heartbeat(self):
+        self._auth()
         return self._c.heartbeat(self.worker)
 
     def leave(self):
+        self._auth()
         return self._c.leave(self.worker)
 
     def members(self):
+        self._auth()
         return self._c.members()
 
     def epoch(self):
+        self._auth()
         return self._c.epoch()
 
     def add_tasks(self, tasks):
+        self._auth()
         return self._c.add_tasks(tasks)
 
     def acquire_task(self):
+        self._auth()
         return self._c.acquire_task(self.worker)
 
     def acquire(self):
+        self._auth()
         return self._c.acquire(self.worker)
 
     def complete_task(self, task):
+        self._auth()
         return self._c.complete_task(self.worker, task)
 
     def fail_task(self, task):
+        self._auth()
         return self._c.fail_task(self.worker, task)
 
     def barrier(self, name, count, timeout=120.0):
+        self._auth()
         return self._c.barrier(self.worker, name, count, timeout)
 
     def sync(self, epoch, timeout=60.0):
+        self._auth()
         return self._c.sync(self.worker, epoch, timeout)
 
     def bump_epoch(self):
+        self._auth()
         # int, matching CoordinatorClient.bump_epoch's unwrapped return.
         return int(self._c.bump_epoch()["epoch"])
 
     def kv_put(self, key, value):
+        self._auth()
         return self._c.kv_put(key, value)
 
     def kv_get(self, key):
+        self._auth()
         return self._c.kv_get(key)
 
     def kv_del(self, key):
+        self._auth()
         return self._c.kv_del(key)
 
     def kv_incr(self, key, delta=1):
+        self._auth()
         return self._c.kv_incr(key, delta)
 
     def status(self):
+        self._auth()
         return self._c.status()
 
     def ping(self):

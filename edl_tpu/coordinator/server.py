@@ -58,6 +58,7 @@ class CoordinatorServer:
         host: str = "127.0.0.1",
         state_file: Optional[str] = None,
         run_id: Optional[str] = None,
+        auth_token: Optional[str] = None,
     ):
         self.port = port or free_port()
         self.task_lease_sec = task_lease_sec
@@ -73,6 +74,11 @@ class CoordinatorServer:
         #: identity stamped into the state file; a mismatched file (another
         #: run's leftovers in the same workspace) is discarded, not resumed.
         self.run_id = run_id
+        #: per-job shared secret (EDL_COORD_TOKEN). None inherits whatever
+        #: the launching pod's env carries (the controller stamps it into
+        #: every pod); "" explicitly disables auth.
+        self.auth_token = auth_token if auth_token is not None \
+            else os.environ.get("EDL_COORD_TOKEN", "")
         self._proc: Optional[subprocess.Popen] = None
 
     @property
@@ -92,10 +98,19 @@ class CoordinatorServer:
             argv += ["--state-file", self.state_file]
         if self.run_id:
             argv += ["--run-id", self.run_id]
+        env = dict(os.environ)
+        # Token travels by env, never argv (/proc/<pid>/cmdline is world-
+        # readable); an empty token scrubs any inherited one so a
+        # no-auth server can't accidentally enforce the pod's secret.
+        if self.auth_token:
+            env["EDL_COORD_TOKEN"] = self.auth_token
+        else:
+            env.pop("EDL_COORD_TOKEN", None)
         self._proc = subprocess.Popen(
             argv,
             stdout=subprocess.DEVNULL,
             stderr=subprocess.DEVNULL,
+            env=env,
         )
         deadline = time.monotonic() + wait
         while time.monotonic() < deadline:
@@ -144,7 +159,8 @@ class CoordinatorServer:
             self._proc = None
 
     def client(self, worker: str = "") -> CoordinatorClient:
-        return CoordinatorClient(port=self.port, worker=worker)
+        return CoordinatorClient(port=self.port, worker=worker,
+                                 token=self.auth_token)
 
     def __enter__(self):
         return self.start()

@@ -305,9 +305,11 @@ struct Conn {
 class Coordinator {
  public:
   Coordinator(double task_lease_sec, double heartbeat_ttl_sec,
-              std::string state_file = "", std::string run_id = "")
+              std::string state_file = "", std::string run_id = "",
+              std::string auth_token = "")
       : task_lease_sec_(task_lease_sec), heartbeat_ttl_sec_(heartbeat_ttl_sec),
-        state_file_(std::move(state_file)), run_id_(std::move(run_id)) {
+        state_file_(std::move(state_file)), run_id_(std::move(run_id)),
+        auth_token_(std::move(auth_token)) {
     if (!state_file_.empty()) load_state();
   }
 
@@ -456,6 +458,7 @@ class Coordinator {
   std::vector<std::pair<int, std::string>> deferred_;
   std::string state_file_;
   std::string run_id_;
+  std::string auth_token_;  // empty = auth disabled (loopback-only dev runs)
   FILE* append_fp_ = nullptr;      // state file held open for delta appends
   std::string pending_;            // delta lines not yet durable
   long long appended_records_ = 0; // deltas since the last snapshot
@@ -938,6 +941,21 @@ std::string Coordinator::op_status() {
 
 std::string Coordinator::handle(const JsonObject& req, int fd) {
   std::string op = get_str(req, "op");
+  // Per-job shared-secret auth (EDL_COORD_TOKEN): with pods binding
+  // 0.0.0.0 so cross-host trainers can dial in, any pod in a shared
+  // cluster could otherwise add_tasks/bump_epoch/poison KV for any job —
+  // the reference's etcd sidecar was equally open (pkg/jobparser.go:
+  // 167-184); this closes that hole. "ping" stays open: it is the
+  // liveness probe and touches no state. Every other op, read or write,
+  // requires the exact token (constant semantics beat a read/write split
+  // nobody can audit).
+  if (!auth_token_.empty() && op != "ping" && get_str(req, "token") != auth_token_) {
+    return JsonWriter()
+        .field("ok", false)
+        .field("error", "unauthorized: bad or missing token")
+        .field("unauthorized", true)
+        .done();
+  }
   if (op == "register") return op_register(req);
   if (op == "heartbeat") return op_heartbeat(req);
   if (op == "leave") return op_leave(req);
@@ -1037,13 +1055,27 @@ int main(int argc, char** argv) {
   }
   signal(SIGPIPE, SIG_IGN);
 
+  // Token via environment, never argv: /proc/<pid>/cmdline is world-
+  // readable on shared nodes. The controller stamps EDL_COORD_TOKEN into
+  // every pod of the job (jobparser make_env), so coordinator and
+  // trainers agree by construction.
+  const char* tok_env = getenv("EDL_COORD_TOKEN");
+  std::string auth_token = tok_env ? tok_env : "";
+  if (auth_token.empty() && host != "127.0.0.1" && host != "localhost") {
+    fprintf(stderr,
+            "edl-coordinator: WARNING: bound to %s with no EDL_COORD_TOKEN — "
+            "any peer that can reach this port can drive the job\n",
+            host.c_str());
+  }
+
   int listener = make_listener(host.c_str(), port);
-  fprintf(stderr, "edl-coordinator listening on %s:%d (task-lease %.1fs, hb-ttl %.1fs%s%s)\n",
+  fprintf(stderr, "edl-coordinator listening on %s:%d (task-lease %.1fs, hb-ttl %.1fs%s%s%s)\n",
           host.c_str(), port, task_lease, hb_ttl,
-          state_file.empty() ? "" : ", state-file ", state_file.c_str());
+          state_file.empty() ? "" : ", state-file ", state_file.c_str(),
+          auth_token.empty() ? "" : ", auth on");
   fflush(stderr);
 
-  Coordinator coord(task_lease, hb_ttl, state_file, run_id);
+  Coordinator coord(task_lease, hb_ttl, state_file, run_id, auth_token);
   if (!coord.state_writable()) {
     fprintf(stderr, "edl-coordinator: --state-file %s not writable\n",
             state_file.c_str());

@@ -1,0 +1,155 @@
+"""Coordinator authentication: per-job EDL_COORD_TOKEN on both backends.
+
+The coordinator binds 0.0.0.0 in pods (cross-host trainers dial in), so
+without auth any pod in a shared cluster could add_tasks/bump_epoch/poison
+KV for any job — the reference's etcd sidecar was exactly that open
+(`pkg/jobparser.go:167-184`). These tests pin the contract on the native
+binary AND the in-process twin: wrong/missing token -> typed
+CoordinatorAuthError on every state-touching op; ping (the liveness probe)
+stays open; controller stamps the secret into every pod's env.
+"""
+
+import pytest
+
+from edl_tpu.coordinator import (
+    CoordinatorAuthError, CoordinatorClient, CoordinatorServer,
+    InProcessCoordinator,
+)
+
+TOKEN = "per-job-secret-123"
+
+
+def test_native_rejects_wrong_and_missing_token():
+    with CoordinatorServer(auth_token=TOKEN) as server:
+        good = server.client("w0")
+        assert good.register()["ok"]
+        assert good.add_tasks(["s0"]) == 1
+
+        for bad_token in ("wrong", ""):
+            bad = CoordinatorClient(port=server.port, worker="intruder",
+                                    token=bad_token)
+            assert bad.ping()  # liveness stays open
+            for call in (bad.register, bad.acquire_task, bad.bump_epoch,
+                         lambda: bad.add_tasks(["x"]),
+                         lambda: bad.kv_put("k", "v"), bad.status):
+                with pytest.raises(CoordinatorAuthError):
+                    call()
+            bad.close()
+
+        # the intruder changed nothing: the real worker still owns the queue
+        assert good.acquire_task() == "s0"
+        assert good.status()["queued"] == 0
+        good.close()
+
+
+def test_native_auth_disabled_without_token():
+    with CoordinatorServer() as server:
+        anon = CoordinatorClient(port=server.port, worker="w", token="")
+        assert anon.register()["ok"]
+        anon.close()
+
+
+def test_native_barrier_sync_raise_not_timeout():
+    """Auth failures must surface as CoordinatorAuthError, not be masked
+    as barrier/sync timeouts (a deployment bug would look like a hang)."""
+    with CoordinatorServer(auth_token=TOKEN) as server:
+        bad = CoordinatorClient(port=server.port, worker="w", token="nope")
+        with pytest.raises(CoordinatorAuthError):
+            bad.barrier("b", 1, timeout=5.0)
+        with pytest.raises(CoordinatorAuthError):
+            bad.sync(0, timeout=5.0)
+        bad.close()
+
+
+def test_inprocess_twin_same_contract():
+    coord = InProcessCoordinator(auth_token=TOKEN)
+    good = coord.client("w0")  # inherits the coordinator's token
+    assert good.register()["ok"]
+    bad = coord.client("intruder", token="wrong")
+    assert bad.ping()
+    for call in (bad.register, bad.acquire_task, bad.bump_epoch,
+                 lambda: bad.add_tasks(["x"]), lambda: bad.kv_put("k", "v"),
+                 bad.status):
+        with pytest.raises(CoordinatorAuthError):
+            call()
+    # twin without a token: open, like the binary
+    open_coord = InProcessCoordinator()
+    assert open_coord.client("w", token="").register()["ok"]
+
+
+def test_controller_stamps_token_and_pods_inherit_it():
+    """Admission generates the secret once, persists it, and every role's
+    env carries it — coordinator and trainers agree by construction."""
+    from edl_tpu.api import ResourceList
+    from edl_tpu.api.types import TrainingJob
+    from edl_tpu.controller import FakeCluster, JobStore, NodeInfo, make_env
+    from edl_tpu.controller.updater import JobUpdater
+
+    job = TrainingJob.from_dict({
+        "metadata": {"name": "j1", "namespace": "default"},
+        "spec": {"fault_tolerant": True,
+                 "trainer": {"min_instance": 1, "max_instance": 2,
+                             "entrypoint": "python train.py"}},
+    })
+    store = JobStore()
+    store.create(job)
+    cluster = FakeCluster([NodeInfo(
+        "n0", ResourceList.make({"cpu": "8", "memory": "16Gi"}))])
+    updater = JobUpdater(job, cluster, store)
+    updater._ensure_auth_token()
+    tok = updater.job.spec.auth_token
+    assert len(tok) == 32  # secrets.token_hex(16)
+    # persisted: a controller restart replays the same token
+    assert store.get("j1").spec.auth_token == tok
+    # second call is a no-op (no token churn under running pods)
+    updater._ensure_auth_token()
+    assert updater.job.spec.auth_token == tok
+    for role in ("trainer", "coordinator"):
+        assert make_env(updater.job, role)["EDL_COORD_TOKEN"] == tok
+
+
+def test_token_round_trips_spec_serialization():
+    from edl_tpu.api.types import TrainingJobSpec
+
+    spec = TrainingJobSpec.from_dict({"auth_token": "abc"})
+    assert spec.auth_token == "abc"
+    assert TrainingJobSpec.from_dict(spec.to_dict()).auth_token == "abc"
+
+
+def test_actuator_authenticates_with_job_token():
+    """The controller's own rescale writes (publish/nudge) must carry the
+    job token — review regression: an actuator without it would silently
+    degrade every auth-enabled job's rescale to the slow fallback path."""
+    from edl_tpu.controller.actuation import CoordinatorActuator
+
+    with CoordinatorServer(auth_token=TOKEN) as server:
+        ok = CoordinatorActuator()
+        ok.set_endpoint("job1", "127.0.0.1", server.port, token=TOKEN)
+        assert ok.publish_expected_world("job1", 4)
+        assert ok.nudge("job1")
+        assert ok.publish_and_nudge("job1", 2)
+
+        anon = CoordinatorActuator()
+        anon.set_endpoint("job1", "127.0.0.1", server.port)
+        assert not anon.publish_expected_world("job1", 4)
+
+        with server.client("w") as c:
+            assert c.kv_get("edl/expected_world") == "2"
+
+
+def test_actuator_track_refreshes_token_after_admission():
+    from edl_tpu.api.types import TrainingJob
+    from edl_tpu.controller.actuation import CoordinatorActuator
+
+    job = TrainingJob.from_dict({
+        "metadata": {"name": "j2", "namespace": "default"},
+        "spec": {"fault_tolerant": True},
+    })
+    act = CoordinatorActuator()
+    act.track(job)  # admission-time: no token yet
+    assert act._tokens.get("j2") is None
+    job.spec.auth_token = "late-minted"
+    act.track(job)  # the spec-update echo re-tracks with the token
+    assert act._tokens["j2"] == "late-minted"
+    # endpoint stays sticky (setdefault), token refreshed
+    assert act._endpoints["j2"][0].startswith("j2-coordinator")
