@@ -164,10 +164,15 @@ class ProcessCluster:
             pod.log_path = os.path.join(self.log_dir, f"{pod.info.name}.log")
             stdout = open(pod.log_path, "w")
         try:
+            # Each pod is a process GROUP (session): a pod kill must take the
+            # launcher AND its training children down together, the way a
+            # K8s pod sandbox teardown does — an orphaned trainer would keep
+            # heartbeating and holding leases for a "deleted" pod.
             pod.proc = subprocess.Popen(
                 shlex.split(pod.entrypoint), env=env,
                 cwd=pod.workspace or None,
                 stdout=stdout, stderr=subprocess.STDOUT,
+                start_new_session=True,
             )
             pod.info.phase = "Running"
             log.info("spawned %s: %s (pid %d)",
@@ -182,11 +187,22 @@ class ProcessCluster:
     def _terminate(self, pod: _ProcPod, grace: float = 10.0) -> None:
         if pod.proc is None or pod.proc.poll() is not None:
             return
+        # SIGTERM to the leader only (K8s signals PID 1; the launcher
+        # forwards to its entry for the graceful drain)...
         pod.proc.terminate()
         try:
             pod.proc.wait(timeout=grace)
         except subprocess.TimeoutExpired:
-            pod.proc.kill()
+            # ...but the grace-expiry escalation kills the whole pod group,
+            # like a sandbox teardown: killing only a wedged leader would
+            # orphan trainer children that keep heartbeating and holding
+            # leases while the cluster re-books their chips.
+            import signal
+
+            try:
+                os.killpg(os.getpgid(pod.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pod.proc.kill()
             pod.proc.wait()
 
     def _reap(self) -> None:
@@ -220,3 +236,54 @@ class ProcessCluster:
         for pod in self.pods:
             if pod.info.phase == "Pending":
                 self._place_and_start(pod)
+
+    # -- chaos / failure-recovery surface --------------------------------------
+
+    def kill_pod(self, pod_name: str) -> None:
+        """SIGKILL the whole pod (process group) — a node crash / OOM kill /
+        forced eviction: no SIGTERM, no drain, no termination log. The pod
+        reaps to Failed; `restart_failed` models the Job controller's
+        replacement."""
+        import signal
+
+        with self._lock:
+            for pod in self.pods:
+                if pod.info.name == pod_name and pod.proc is not None:
+                    delivered = True
+                    try:
+                        os.killpg(os.getpgid(pod.proc.pid), signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass  # already gone; reap below
+                    except PermissionError:
+                        delivered = False  # never block the cluster lock
+                        pod.proc.kill()    # waiting on an unkilled group
+                    if delivered:
+                        pod.proc.wait()
+                    else:
+                        try:
+                            pod.proc.wait(timeout=5.0)
+                        except subprocess.TimeoutExpired:
+                            pass
+                    self._reap()
+                    return
+        raise KeyError(f"no live pod {pod_name}")
+
+    def restart_failed(self, job_name: str) -> int:
+        """The K8s Job controller's reconcile for crashed pods: replace
+        Failed trainer pods with fresh ones up to the job's parallelism
+        (new pod name — the replacement registers as a new worker and the
+        dead one's membership/leases expire by TTL). Returns pods spawned."""
+        with self._lock:
+            self._reap()
+            if (job_name not in self._parallelism
+                    or self._templates.get(job_name, {}).get("trainer") is None):
+                return 0
+            failed = [p for p in self.pods
+                      if p.info.job_name == job_name
+                      and p.info.role == "trainer"
+                      and p.info.phase == "Failed"]
+            for pod in failed:  # terminal records: GC like a Job controller
+                self.pods.remove(pod)
+            before = len(self.pods)
+            self._reconcile(job_name)  # the spawn-up half lives there
+            return len(self.pods) - before

@@ -153,6 +153,12 @@ class JobUpdater:
             self._last_written_status = fp
         except KeyError:
             pass  # job deleted from the store mid-flight
+        except Exception as e:  # noqa: BLE001 — e.g. ApiError after the
+            # store's conflict retries ran dry. The fingerprint stays
+            # unrecorded, so the next convert tick rewrites; an actor crash
+            # here would take the whole job down over a status blip.
+            log.warning("status writeback for %s failed (will retry): %s",
+                        self.job.name, e)
 
     # -- materialization (ref: createTrainingJob, :282-293) --------------------
 

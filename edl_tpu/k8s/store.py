@@ -23,6 +23,7 @@ from __future__ import annotations
 import copy
 import logging
 import threading
+import time
 from typing import Dict, List, Optional
 
 from edl_tpu.api.types import TrainingJob, TrainingJobStatus
@@ -137,16 +138,28 @@ class K8sJobStore:
         self, name: str, status: TrainingJobStatus, namespace: str = "default"
     ) -> TrainingJob:
         body = to_crd(TrainingJob(name=name, namespace=namespace, status=status))
-        try:
-            out = self.api.patch(
-                self._path(name, namespace) + "/status",
-                {"status": body["status"]},
-            )
-        except ApiError as e:
-            if e.not_found:
-                raise KeyError(f"trainingjob {namespace}/{name} not found") from e
-            raise
-        return from_crd(out)
+        last: Optional[ApiError] = None
+        for attempt in range(4):
+            try:
+                out = self.api.patch(
+                    self._path(name, namespace) + "/status",
+                    {"status": body["status"]},
+                )
+                return from_crd(out)
+            except ApiError as e:
+                if e.not_found:
+                    raise KeyError(
+                        f"trainingjob {namespace}/{name} not found"
+                    ) from e
+                if not e.conflict:
+                    raise
+                # 409 on the status subresource: a concurrent writer moved
+                # the rv between our read and write. A merge patch carries
+                # no rv, so the retry applies our intent to the fresh
+                # object — the standard controller-side conflict loop.
+                last = e
+                time.sleep(0.02 * (attempt + 1))
+        raise last  # conflicts 4x in a row: surface it
 
     def delete(self, name: str, namespace: str = "default") -> TrainingJob:
         try:
@@ -260,9 +273,13 @@ class K8sJobStore:
         rv = (obj.get("metadata", {}) or {}).get("resourceVersion")
         if rv:
             self._resource_version = rv
+        kind = event.get("type")
+        if kind == "BOOKMARK":
+            # rv-progress marker (metadata-only object): advance the
+            # cursor — already done above — and deliver nothing.
+            return
         job = from_crd(obj)
         key = self._key(job.name, job.namespace)
-        kind = event.get("type")
         if kind == "ADDED":
             with self._lock:
                 known = key in self._cache

@@ -68,6 +68,17 @@ class FakeApiServer:
         self.pod_counter = 0
         self._server: Optional[ThreadingHTTPServer] = None
         self._closing = False
+        # -- fault injection (real-apiserver failure modes) --------------------
+        #: fail the next N /status PATCHes with 409 Conflict (rv races)
+        self.status_conflicts = 0
+        #: end each watch stream with an ERROR/410 event after N data events
+        #: (etcd compaction mid-stream); None = never
+        self.watch_error_410_after: Optional[int] = None
+        #: sleep this long before answering LISTs (a loaded apiserver)
+        self.list_delay_sec = 0.0
+        #: emit a BOOKMARK event on idle watch waits (rv-progress markers
+        #: real apiservers send; clients must advance rv without notifying)
+        self.send_bookmarks = False
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -256,6 +267,10 @@ def _make_handler(srv: FakeApiServer):
             self._error(404, f"no route {self.path}")
 
         def _list(self, table, namespace, params):
+            if srv.list_delay_sec:
+                import time as _t
+
+                _t.sleep(srv.list_delay_sec)
             selector = params.get("labelSelector", "")
             items = [
                 obj for (ns, _), obj in table.items()
@@ -309,7 +324,9 @@ def _make_handler(srv: FakeApiServer):
             import time
             deadline = time.monotonic() + timeout
             cursor = since
+            emitted = 0
             while True:
+                bookmark = None
                 with srv.event_cond:
                     pending = [
                         e for e in srv.tj_events
@@ -321,13 +338,49 @@ def _make_handler(srv: FakeApiServer):
                     if not pending:
                         if srv._closing or time.monotonic() >= deadline:
                             break
-                        srv.event_cond.wait(
-                            timeout=min(0.2, max(0.0, deadline - time.monotonic()))
-                        )
-                        continue
+                        if srv.send_bookmarks:
+                            # rv-progress marker on an idle stream, exactly
+                            # what a real apiserver's allowWatchBookmarks
+                            # path emits: metadata-only object, current rv.
+                            # Built here, WRITTEN outside the lock: wfile
+                            # can block on a slow client, and event_cond
+                            # shares the server's global lock.
+                            bookmark = {"type": "BOOKMARK", "object": {
+                                "metadata": {
+                                    "resourceVersion": str(srv.rv_counter),
+                                    "namespace": namespace or "default",
+                                },
+                            }}
+                        else:
+                            srv.event_cond.wait(
+                                timeout=min(0.2, max(0.0,
+                                                     deadline - time.monotonic()))
+                            )
+                            continue
+                if bookmark is not None:
+                    if not emit(bookmark):
+                        return
+                    time.sleep(0.2)
+                    continue
                 for event in pending:
                     cursor = event["rv"]
                     if not emit(event):
+                        return
+                    emitted += 1
+                    if (srv.watch_error_410_after is not None
+                            and emitted >= srv.watch_error_410_after):
+                        # etcd compacted past the client's rv mid-stream:
+                        # the standard Gone error event, then stream end —
+                        # the informer must relist, not crash or spin.
+                        emit({"type": "ERROR", "object": {
+                            "kind": "Status", "code": 410, "reason": "Gone",
+                            "message": "too old resource version",
+                        }})
+                        try:
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                        except OSError:
+                            pass
                         return
             try:
                 self.wfile.write(b"0\r\n\r\n")
@@ -392,6 +445,12 @@ def _make_handler(srv: FakeApiServer):
                     obj = srv.trainingjobs.get((ns, name))
                     if obj is None:
                         return self._error(404, "trainingjob not found")
+                    if is_status and srv.status_conflicts > 0:
+                        srv.status_conflicts -= 1
+                        return self._error(
+                            409, "Operation cannot be fulfilled: object "
+                                 "has been modified"
+                        )
                     if is_status:
                         # status subresource: only .status is applied
                         obj["status"] = body.get("status", {})

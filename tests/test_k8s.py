@@ -418,3 +418,128 @@ def test_cli_run_selects_k8s_backend(apiserver, tmp_path):
     assert rc == 0
     # the CRD object landed on the apiserver and reached Succeeded
     assert srv.trainingjobs[("default", "demo")]["status"]["phase"] == "Succeeded"
+
+
+# -- real-apiserver failure modes (fault injection) ----------------------------
+
+
+def test_status_conflict_retried_transparently(apiserver):
+    """409 on the /status subresource (rv race with a concurrent writer):
+    the store retries the merge patch; callers never see the conflict."""
+    srv, base = apiserver
+    store = K8sJobStore(_client(base))
+    store.create(_job())
+    status = store.get("demo").status
+    status.phase = JobPhase.RUNNING
+    srv.status_conflicts = 2  # two rejections, then accept
+    out = store.update_status("demo", status)
+    assert out.status.phase == JobPhase.RUNNING
+    assert srv.status_conflicts == 0
+
+
+def test_status_conflict_exhaustion_surfaces_and_updater_survives(apiserver):
+    srv, base = apiserver
+    store = K8sJobStore(_client(base))
+    store.create(_job())
+    status = store.get("demo").status
+    status.phase = JobPhase.RUNNING
+    srv.status_conflicts = 99
+    with pytest.raises(ApiError) as ei:
+        store.update_status("demo", status)
+    assert ei.value.conflict
+    srv.status_conflicts = 0
+
+    # the updater's status writeback must absorb the same failure (the
+    # next convert tick retries) instead of crashing the job actor
+    from edl_tpu.controller import FakeCluster, NodeInfo
+    from edl_tpu.controller.updater import JobUpdater
+
+    cluster = FakeCluster(
+        [NodeInfo("n0", ResourceList.make({"cpu": "8", "memory": "16Gi"}))]
+    )
+    updater = JobUpdater(store.get("demo"), cluster, store)
+    srv.status_conflicts = 99
+    updater._set_phase(JobPhase.CREATING)  # must not raise
+    srv.status_conflicts = 0
+    updater._set_phase(JobPhase.RUNNING)
+    assert store.get("demo").status.phase == JobPhase.RUNNING
+
+
+def test_watch_survives_midstream_410(apiserver):
+    """etcd compaction mid-stream: the server emits ERROR/410 and closes;
+    the informer must relist and keep delivering events, losing nothing."""
+    srv, base = apiserver
+    store = K8sJobStore(_client(base), watch_timeout_seconds=5.0)
+    events = []
+
+    class Recorder:
+        def on_add(self, job):
+            events.append(("add", job.name))
+
+        def on_update(self, job):
+            events.append(("update", job.name, job.status.phase))
+
+        def on_del(self, job):
+            events.append(("del", job.name))
+
+    srv.watch_error_410_after = 1  # every stream dies after one event
+    store.create(_job())
+    store.watch(Recorder(), replay=True)
+    assert ("add", "demo") in events
+
+    for phase in (JobPhase.CREATING, JobPhase.RUNNING):
+        status = store.get("demo").status
+        status.phase = phase
+        store.update_status("demo", status)
+        time.sleep(0.1)
+    job2 = _job()
+    job2.name = "demo2"
+    store.create(job2)
+
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and not any(
+        e[0] == "add" and e[1] == "demo2" for e in events
+    ):
+        time.sleep(0.05)
+    running_seen = any(
+        e == ("update", "demo", JobPhase.RUNNING) for e in events
+    )
+    store.stop()
+    assert any(e[0] == "add" and e[1] == "demo2" for e in events), events
+    assert running_seen, events
+
+
+def test_watch_tolerates_bookmarks_and_slow_lists(apiserver):
+    """BOOKMARK events advance the rv cursor without notifying watchers;
+    a slow LIST (loaded apiserver) delays but does not break the informer."""
+    srv, base = apiserver
+    srv.send_bookmarks = True
+    srv.list_delay_sec = 0.5
+    store = K8sJobStore(_client(base), watch_timeout_seconds=2.0)
+    events = []
+
+    class Recorder:
+        def on_add(self, job):
+            events.append(("add", job.name))
+
+        def on_update(self, job):
+            events.append(("update", job.name))
+
+        def on_del(self, job):
+            events.append(("del", job.name))
+
+    store.create(_job())
+    store.watch(Recorder(), replay=True)
+    # let at least one idle-watch cycle of bookmarks flow
+    time.sleep(1.0)
+    n_before = len(events)
+    status = store.get("demo").status
+    status.phase = JobPhase.RUNNING
+    store.update_status("demo", status)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and len(events) == n_before:
+        time.sleep(0.05)
+    store.stop()
+    # bookmarks delivered no spurious watcher events
+    assert [e for e in events[:n_before]] == [("add", "demo")]
+    assert ("update", "demo") in events
