@@ -32,24 +32,12 @@ _DEFAULT_SHAPES = [
 
 def main() -> None:
     import jax
-
-    if os.environ.get("EDL_BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["EDL_BENCH_PLATFORM"])
-
     import jax.numpy as jnp
     import numpy as np
 
-    from bench import probe_devices
+    from bench import probe_or_exit
 
-    devices, reason = probe_devices(
-        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
-        allow_cpu=os.environ.get("EDL_BENCH_ALLOW_CPU") == "1"
-        or os.environ.get("EDL_BENCH_PLATFORM") == "cpu",
-    )
-    if devices is None:
-        print(json.dumps({"metric": "flash_attention_speedup",
-                          "error": reason}))
-        os._exit(0)
+    devices = probe_or_exit("flash_attention_speedup")
 
     from edl_tpu.ops import flash_attention
     from edl_tpu.parallel.ring_attention import dense_attention
@@ -62,17 +50,33 @@ def main() -> None:
     steps = max(1, int(os.environ.get("EDL_BENCH_STEPS", "10")))
 
     def arm(fn, q, k, v):
-        loss = jax.jit(jax.grad(lambda q: jnp.sum(fn(q, k, v) ** 2)))
+        # Full training direction: grads w.r.t. q AND k/v. Grad-of-q alone
+        # would let XLA dead-code-eliminate the flash dk/dv backward kernel
+        # (it is a separate pallas_call) and overstate MFU by ~50%.
+        loss = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v) ** 2), argnums=(0, 1, 2)
+        ))
 
         def window():
             t0 = time.perf_counter()
             for _ in range(steps):
-                g = loss(q)
+                g = loss(q, k, v)
             jax.block_until_ready(g)
             return time.perf_counter() - t0
 
-        loss(q).block_until_ready()  # compile + warm
+        jax.block_until_ready(loss(q, k, v))  # compile + warm
         return window
+
+    from edl_tpu.tools.mfu import peak_tflops_per_chip
+
+    peak = peak_tflops_per_chip(devices[0])
+
+    def attn_train_flops(B, S, H, D):
+        """fwd+bwd matmul FLOPs of causal attention (MFU convention:
+        QK^T and PV are 2*S*D/token each, halved by the mask, x3 for the
+        backward; the flash backward's score recompute is excluded like
+        any remat)."""
+        return 3.0 * 0.5 * (4 * S * D) * B * S * H
 
     rng = np.random.default_rng(0)
     for B, S, H, D in shapes:
@@ -95,8 +99,16 @@ def main() -> None:
             record["dense_error"] = str(e)[:200]
             record["note"] = "dense arm failed (expected at long S); flash ran"
             ts = [run_flash() for _ in range(windows)]
-            record["flash_ms_per_step"] = round(
-                1e3 * statistics.median(ts) / steps, 3
+            flash_ms = 1e3 * statistics.median(ts) / steps
+            flops = attn_train_flops(B, S, H, D)
+            achieved = flops / (flash_ms / 1e3) / 1e12
+            record.update(
+                flash_ms_per_step=round(flash_ms, 3),
+                model_flops=flops,
+                flops_method="analytic",
+                tflops_per_sec=round(achieved, 3),
+                peak_tflops=peak,
+                mfu=round(achieved / peak, 4) if peak else None,
             )
             print(json.dumps(record))
             continue
@@ -109,11 +121,19 @@ def main() -> None:
             fl.append(f)
             dn.append(d)
             ratios.append(d / f)
+        flash_ms = 1e3 * statistics.median(fl) / steps
+        flops = attn_train_flops(B, S, H, D)
+        achieved = flops / (flash_ms / 1e3) / 1e12
         record.update(
-            flash_ms_per_step=round(1e3 * statistics.median(fl) / steps, 3),
+            flash_ms_per_step=round(flash_ms, 3),
             dense_ms_per_step=round(1e3 * statistics.median(dn) / steps, 3),
             speedup=round(statistics.median(ratios), 3),
             paired_ratios=[round(r, 3) for r in ratios],
+            model_flops=flops,
+            flops_method="analytic",
+            tflops_per_sec=round(achieved, 3),
+            peak_tflops=peak,
+            mfu=round(achieved / peak, 4) if peak else None,
         )
         print(json.dumps(record))
 

@@ -50,6 +50,12 @@ def parse_args():
                    help='non-data mesh axes, e.g. \'{"seq":2,"model":2}\'')
     p.add_argument("--remat", action="store_true",
                    help="per-block activation rematerialization")
+    p.add_argument("--pipeline-schedule", default="gpipe",
+                   choices=("gpipe", "1f1b"),
+                   help="microbatch schedule on the pipe axis: gpipe "
+                        "(default) or the O(pp)-activation 1f1b")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="pipeline microbatches (default: pipe size)")
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer moments over the data axis")
     p.add_argument("--num-passes", type=int,
@@ -69,6 +75,8 @@ def main() -> None:
         vocab_size=args.vocab_size, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
         seq_len=args.seq_len, remat=args.remat,
+        pipeline_schedule=args.pipeline_schedule,
+        microbatches=args.microbatches,
     )
     source = SyntheticShardSource(model, batch_size=args.batch_size,
                                   batches_per_shard=args.batches_per_shard)
