@@ -22,6 +22,7 @@ import os
 import shlex
 import subprocess
 import sys
+import tempfile
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -230,6 +231,17 @@ def start_trainer(
     env = dict(os.environ)
     env.update(extra_env or {})
     cwd = ctx.workspace or None
+    # Persistent XLA compilation cache for the entry, pod-local by default:
+    # a warm restart (RESCALE_EXIT_CODE) re-runs the SAME program at a new
+    # world size it may well have compiled before, and a rescale's recovery
+    # budget is dominated by exactly that recompile on real chips
+    # (BENCH_RESCALE_ONCHIP.json itemizes it). Opt out by exporting
+    # JAX_COMPILATION_CACHE_DIR= (empty).
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(
+            ctx.workspace or tempfile.gettempdir(),
+            f"edl-xla-cache-{ctx.job_name or 'job'}",
+        )
     # Forward pod termination to the entry: K8s (and ProcessCluster)
     # SIGTERM the launcher — pod PID 1. Without forwarding, the training
     # child outlives its pod as an orphan, holding gang membership and

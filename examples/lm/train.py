@@ -8,7 +8,8 @@ composes every axis the parallel layer ships:
     data   — batch sharding (gradients psum over ICI)
     seq    — ring-attention sequence/context parallelism for long inputs
     model  — megatron tensor parallelism
-    pipe   — GPipe pipeline stages
+    pipe   — pipeline stages (GPipe default; --pipeline-schedule 1f1b
+             for the O(pp)-activation combined schedule)
 
 plus the two HBM levers: per-block rematerialization (``--remat``) and
 ZeRO-1 optimizer-state sharding (``--zero1``).

@@ -130,6 +130,33 @@ class TestTrainerExec:
             assert start_trainer(ctx) == 1
             assert "budget exhausted" in term.read_text()
 
+    def test_start_trainer_sets_persistent_compile_cache(self, tmp_path,
+                                                         monkeypatch):
+        """Warm restarts re-run the same XLA program; the launcher points
+        the entry at a pod-local persistent compile cache so the rescale
+        budget pays the compile once. Explicit env (incl. empty = opt out)
+        wins."""
+        from edl_tpu.coordinator.server import CoordinatorServer
+        from edl_tpu.launcher.launch import start_trainer
+
+        monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+        with CoordinatorServer() as server:
+            out = tmp_path / "env.txt"
+            entry = (f"{sys.executable} -c \"import os; open(r'{out}','w')"
+                     f".write(os.environ.get('JAX_COMPILATION_CACHE_DIR',''))\"")
+            ctx = LaunchContext(
+                job_name="cachejob", coordinator_endpoint=server.address,
+                entry=entry, workspace=str(tmp_path),
+                termination_log=str(tmp_path / "term"),
+            )
+            assert start_trainer(ctx) == 0
+            cache_dir = out.read_text()
+            assert cache_dir == str(tmp_path / "edl-xla-cache-cachejob")
+
+            monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "")
+            assert start_trainer(ctx) == 0
+            assert out.read_text() == ""  # explicit opt-out respected
+
 
 def _nodes(n=2):
     return [

@@ -97,8 +97,12 @@ def test_bubble_fraction_accounting():
 
 @pytest.mark.parametrize(
     "axes,microbatches",
-    [({"pipe": 2, "data": 4}, 4), ({"pipe": 4, "data": 2}, 8)],
-    ids=["pp2-M4", "pp4-M8"],
+    [({"pipe": 2, "data": 4}, 4), ({"pipe": 4, "data": 2}, 8),
+     # the risky composition: the combined scan's per-tick jax.vjp runs
+     # THROUGH ring attention's seq-axis ppermutes and the tensor-parallel
+     # psums inside the stage function
+     ({"pipe": 2, "seq": 2, "model": 2}, 4)],
+    ids=["pp2-M4", "pp4-M8", "pp2-sp2-tp2"],
 )
 def test_1f1b_matches_gpipe_in_model(axes, microbatches):
     """Schedule choice must change memory/wall profile, not math: loss AND
