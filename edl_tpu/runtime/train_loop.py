@@ -297,6 +297,11 @@ class Trainer:
         t0 = time.perf_counter()
         samples = 0
         if profiler is not None:
+            # Let the profiler's summary account FLOPs/MFU without the
+            # caller having to thread the model/mesh through twice.
+            if getattr(profiler, "model", None) is None:
+                profiler.model = self.model
+                profiler.n_chips = max(1, self.mesh.devices.size)
             profiler.start()
         for batch in batches:
             placed = self.place_batch(batch)

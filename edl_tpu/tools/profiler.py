@@ -81,10 +81,16 @@ class StepProfiler:
     """
 
     def __init__(self, warmup: int = 1, window: int = 10_000,
-                 sink: Optional[TextIO] = None):
+                 sink: Optional[TextIO] = None, model: Optional[Any] = None,
+                 n_chips: int = 1):
         self.warmup = warmup
         self.window = window
         self.sink = sink
+        #: optional zoo model: when it declares analytic ``flops_per_step``
+        #: (models.base convention) the summary also reports achieved
+        #: TFLOP/s per chip and MFU against the live chip's peak.
+        self.model = model
+        self.n_chips = max(1, n_chips)
         self.records: List[StepRecord] = []
         self._count = 0
         self._mark: Optional[float] = None
@@ -142,7 +148,7 @@ class StepProfiler:
         times = sorted(r.seconds for r in steady)
         total = sum(times)
         samples = sum(r.samples for r in steady)
-        return {
+        out = {
             "steps": float(self._count),
             "steady_steps": float(len(steady)),
             "samples_per_sec": samples / total if total > 0 else float("inf"),
@@ -151,6 +157,21 @@ class StepProfiler:
             "step_time_p95_s": _percentile(times, 0.95),
             "step_time_max_s": times[-1],
         }
+        if getattr(self.model, "flops_per_step", None) is not None \
+                and total > 0 and samples:
+            from edl_tpu.tools.mfu import mfu_fields
+
+            # One accounting implementation (mfu.mfu_fields — the benches'):
+            # analytic FLOPs are linear in batch size (tested invariant), so
+            # batch_size=1 at the steady samples/s rate gives the achieved
+            # figure. Only the non-null fields join the summary.
+            acct = mfu_fields(self.model, 1, samples / total,
+                              n_chips=self.n_chips, device=jax.devices()[0])
+            if acct.get("tflops_per_sec") is not None:
+                out["tflops_per_sec"] = acct["tflops_per_sec"]
+            if acct.get("mfu") is not None:
+                out["mfu"] = acct["mfu"]
+        return out
 
 
 # -- XLA trace capture ---------------------------------------------------------

@@ -130,3 +130,27 @@ def test_device_memory_stats_shape():
     for per_dev in stats.values():
         for v in per_dev.values():
             assert isinstance(v, int)
+
+
+def test_summary_reports_mfu_when_model_given(monkeypatch):
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.tools.profiler import StepProfiler
+
+    # pin the no-peak path: the env override would add an mfu key
+    monkeypatch.delenv("EDL_TPU_PEAK_TFLOPS", raising=False)
+    prof = StepProfiler(warmup=0, model=fit_a_line.MODEL)
+    prof.start()
+    for _ in range(3):
+        prof.step(64)
+    s = prof.summary()
+    assert s["tflops_per_sec"] > 0
+    # per-sample flops x rate consistency
+    expected = fit_a_line.MODEL.flops_per_step(1) * s["samples_per_sec"] / 1e12
+    assert s["tflops_per_sec"] == round(expected, 3)  # mfu_fields rounds
+    # CPU backend: no peak table entry, so no mfu key
+    assert "mfu" not in s
+
+    bare = StepProfiler(warmup=0)
+    bare.start()
+    bare.step(64)
+    assert "tflops_per_sec" not in bare.summary()
