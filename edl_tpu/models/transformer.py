@@ -83,6 +83,17 @@ class TransformerConfig:
     #: associatively in (out, lse) form, gradients flow through the
     #: kernel's differentiable lse). Interpret mode on CPU.
     flash: bool = True
+    #: mixture-of-experts FFN: >0 replaces every block's dense FFN with
+    #: `moe_experts` switch-routed (top-1) experts whose weights shard over
+    #: ``expert_axis`` — token dispatch is an `all_to_all` on ICI, the
+    #: dense-model completion of the embedding layer's expert story
+    #: (`parallel.embedding`). 0 = dense FFN. Experts do not split over the
+    #: tp axis (attention still does); capacity-dropped tokens pass through
+    #: on the residual. v1 ships no load-balance aux loss (mechanics, not
+    #: recipe — the training-quality term is a straightforward follow-on).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    expert_axis: str = "expert"
 
     @property
     def head_dim(self) -> int:
@@ -109,18 +120,31 @@ def _block_spec(cfg: TransformerConfig, mesh: Mesh) -> Dict[str, P]:
     its contiguous chunk of blocks."""
     tp = cfg.tp_axis if cfg.tp_axis in mesh.axis_names else None
     pp = cfg.pp_axis if cfg.pp_axis in mesh.axis_names else None
-    return {
+    spec = {
         "ln1": P(pp, None),
         "wqkv": P(pp, None, None, tp, None),  # (L, D, 3, H, Dh) col-sharded
         "bqkv": P(pp, None, tp, None),
         "wo": P(pp, tp, None, None),  # (L, H, Dh, D) row-sharded -> psum
         "bo": P(pp, None),
         "ln2": P(pp, None),
-        "win": P(pp, None, tp),  # (L, D, F) col-sharded
-        "bin": P(pp, tp),
-        "wout": P(pp, tp, None),  # (L, F, D) row-sharded -> psum
-        "bout": P(pp, None),
     }
+    if cfg.moe_experts > 0:
+        ep = cfg.expert_axis if cfg.expert_axis in mesh.axis_names else None
+        spec.update({
+            "router": P(pp, None, None),      # (L, D, E) replicated
+            "w_up": P(pp, ep, None, None),    # (L, E, D, F) expert-sharded
+            "b_up": P(pp, ep, None),
+            "w_down": P(pp, ep, None, None),  # (L, E, F, D)
+            "b_down": P(pp, ep, None),
+        })
+    else:
+        spec.update({
+            "win": P(pp, None, tp),  # (L, D, F) col-sharded
+            "bin": P(pp, tp),
+            "wout": P(pp, tp, None),  # (L, F, D) row-sharded -> psum
+            "bout": P(pp, None),
+        })
+    return spec
 
 
 def _param_spec(cfg: TransformerConfig, mesh: Mesh) -> dict:
@@ -154,30 +178,50 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
             f"unknown pipeline_schedule {cfg.pipeline_schedule!r}; "
             "expected 'gpipe' or '1f1b'"
         )
+    E = cfg.moe_experts
+    if E > 0 and E % _axis_size(mesh, cfg.expert_axis):
+        raise ValueError(
+            f"moe_experts={E} must be divisible by "
+            f"ep={_axis_size(mesh, cfg.expert_axis)}"
+        )
     D, H, Dh, F, L, V = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
         cfg.vocab_size,
     )
-    ks = jax.random.split(key, 7)
-    host = {
-        "embed": jax.random.normal(ks[0], (V, D), jnp.float32) * 0.02,
-        "pos": jax.random.normal(ks[1], (cfg.seq_len, D), jnp.float32) * 0.02,
-        "blocks": {
-            "ln1": jnp.ones((L, D), jnp.float32),
-            "wqkv": jax.random.normal(ks[2], (L, D, 3, H, Dh), jnp.float32)
-            * math.sqrt(1.0 / D),
-            "bqkv": jnp.zeros((L, 3, H, Dh), jnp.float32),
-            "wo": jax.random.normal(ks[3], (L, H, Dh, D), jnp.float32)
-            * math.sqrt(1.0 / D),
-            "bo": jnp.zeros((L, D), jnp.float32),
-            "ln2": jnp.ones((L, D), jnp.float32),
+    ks = jax.random.split(key, 8)
+    blocks = {
+        "ln1": jnp.ones((L, D), jnp.float32),
+        "wqkv": jax.random.normal(ks[2], (L, D, 3, H, Dh), jnp.float32)
+        * math.sqrt(1.0 / D),
+        "bqkv": jnp.zeros((L, 3, H, Dh), jnp.float32),
+        "wo": jax.random.normal(ks[3], (L, H, Dh, D), jnp.float32)
+        * math.sqrt(1.0 / D),
+        "bo": jnp.zeros((L, D), jnp.float32),
+        "ln2": jnp.ones((L, D), jnp.float32),
+    }
+    if E > 0:
+        blocks.update({
+            "router": jax.random.normal(ks[7], (L, D, E), jnp.float32) * 0.02,
+            "w_up": jax.random.normal(ks[4], (L, E, D, F), jnp.float32)
+            * math.sqrt(2.0 / D),
+            "b_up": jnp.zeros((L, E, F), jnp.float32),
+            "w_down": jax.random.normal(ks[5], (L, E, F, D), jnp.float32)
+            * math.sqrt(1.0 / F),
+            "b_down": jnp.zeros((L, E, D), jnp.float32),
+        })
+    else:
+        blocks.update({
             "win": jax.random.normal(ks[4], (L, D, F), jnp.float32)
             * math.sqrt(2.0 / D),
             "bin": jnp.zeros((L, F), jnp.float32),
             "wout": jax.random.normal(ks[5], (L, F, D), jnp.float32)
             * math.sqrt(1.0 / F),
             "bout": jnp.zeros((L, D), jnp.float32),
-        },
+        })
+    host = {
+        "embed": jax.random.normal(ks[0], (V, D), jnp.float32) * 0.02,
+        "pos": jax.random.normal(ks[1], (cfg.seq_len, D), jnp.float32) * 0.02,
+        "blocks": blocks,
         "lnf": jnp.ones((D,), jnp.float32),
         "head": jax.random.normal(ks[6], (D, V), jnp.float32) * 0.02,
     }
@@ -188,6 +232,70 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
         spec,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def _moe_ffn(cfg: TransformerConfig, mesh: Mesh, h: jax.Array, bp: dict):
+    """Switch (top-1) mixture-of-experts FFN on local shards.
+
+    ``h``: (Bl, Sl, D) bf16 normed activations. Expert weights arrive
+    expert-sharded: (E_local, D, F) where E_local = E/ep. The classic
+    einsum-dispatch formulation (Mesh-TensorFlow / Switch):
+
+      1. route: per-token top-1 expert + gate prob (f32 softmax);
+      2. dispatch einsum packs each expert's first-C tokens into static
+         (E, C, D) slots (capacity-dropped tokens contribute nothing and
+         ride the residual unchanged);
+      3. `all_to_all` over the expert axis turns expert-major slots into
+         device-major: every device receives ITS experts' slots from all
+         ep peers — the MoE shuffle, on ICI;
+      4. batched expert FFN over the E_local dim;
+      5. reverse `all_to_all`, combine einsum (dispatch x gate) unpacks
+         slots back to token positions.
+
+    Without an expert axis (ep=1) the two collectives vanish and the same
+    math runs locally — layout changes, math doesn't (tested invariant).
+    """
+    B, S, D = h.shape
+    E, F = cfg.moe_experts, cfg.d_ff
+    ep = _axis_size(mesh, cfg.expert_axis)
+    T = B * S
+    cap = max(1, math.ceil(T / E * cfg.moe_capacity_factor))
+    tok = h.reshape(T, D)
+
+    logits = jnp.einsum(
+        "td,de->te", tok.astype(jnp.float32), bp["router"]
+    )  # (T, E) f32 — routing decisions deserve full precision
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate = probs.max(axis=-1)  # (T,)
+    choice = probs.argmax(axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)  # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # slot index or -1
+    keep = (pos >= 0) & (pos < cap)
+    dispatch = (
+        jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.bfloat16)
+        * keep[..., None].astype(jnp.bfloat16)
+    )  # (T, E, C)
+
+    slots = jnp.einsum("tec,td->ecd", dispatch, tok)  # (E, C, D)
+    if ep > 1:
+        # expert-major -> device-major: each device keeps rows for its own
+        # E_local experts and receives the matching rows from every peer,
+        # concatenated along the slot dim -> (E_local, ep*C, D).
+        slots = jax.lax.all_to_all(
+            slots, cfg.expert_axis, split_axis=0, concat_axis=1, tiled=True
+        )
+    up = jnp.einsum("ecd,edf->ecf", slots, bp["w_up"].astype(jnp.bfloat16))
+    act = jax.nn.gelu(up + bp["b_up"][:, None, :].astype(jnp.bfloat16))
+    down = jnp.einsum(
+        "ecf,efd->ecd", act, bp["w_down"].astype(jnp.bfloat16)
+    ) + bp["b_down"][:, None, :].astype(jnp.bfloat16)
+    if ep > 1:
+        down = jax.lax.all_to_all(
+            down, cfg.expert_axis, split_axis=1, concat_axis=0, tiled=True
+        )
+    combine = dispatch * gate[:, None, None].astype(jnp.bfloat16)
+    out = jnp.einsum("ecd,tec->td", down, combine)  # (T, D)
+    return out.reshape(B, S, D).astype(jnp.float32)
 
 
 def _block(cfg: TransformerConfig, mesh: Mesh, n_sp: int, x: jax.Array, bp: dict):
@@ -210,10 +318,13 @@ def _block(cfg: TransformerConfig, mesh: Mesh, n_sp: int, x: jax.Array, bp: dict
     out = _maybe_psum(out.astype(jnp.float32), mesh, cfg.tp_axis) + bp["bo"]
     x = x + out.astype(jnp.bfloat16)
     h = _rmsnorm(x, bp["ln2"])
-    f = jnp.einsum("bsd,df->bsf", h, bp["win"].astype(jnp.bfloat16))
-    f = jax.nn.gelu(f + bp["bin"].astype(jnp.bfloat16))
-    o = jnp.einsum("bsf,fd->bsd", f, bp["wout"].astype(jnp.bfloat16))
-    o = _maybe_psum(o.astype(jnp.float32), mesh, cfg.tp_axis) + bp["bout"]
+    if cfg.moe_experts > 0:
+        o = _moe_ffn(cfg, mesh, h, bp)
+    else:
+        f = jnp.einsum("bsd,df->bsf", h, bp["win"].astype(jnp.bfloat16))
+        f = jax.nn.gelu(f + bp["bin"].astype(jnp.bfloat16))
+        o = jnp.einsum("bsf,fd->bsd", f, bp["wout"].astype(jnp.bfloat16))
+        o = _maybe_psum(o.astype(jnp.float32), mesh, cfg.tp_axis) + bp["bout"]
     return x + o.astype(jnp.bfloat16)
 
 
@@ -322,8 +433,12 @@ def _flops_per_step(cfg: TransformerConfig, batch_size: int) -> float:
     the LM head 2DV. Backward = 2x forward; remat recompute excluded.
     """
     D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    # top-1 MoE: each token still visits ONE expert's 4DF FFN; the router
+    # matmul is the only extra (capacity-dropped tokens still count — MFU
+    # numerator convention, like remat).
+    ffn = 4 * D * F + (2 * D * cfg.moe_experts if cfg.moe_experts else 0)
     per_token = (
-        L * (6 * D * D + 2 * D * D + 4 * D * F + 0.5 * (4 * cfg.seq_len * D))
+        L * (6 * D * D + 2 * D * D + ffn + 0.5 * (4 * cfg.seq_len * D))
         + 2 * D * cfg.vocab_size
     )
     return 3.0 * per_token * cfg.seq_len * batch_size
