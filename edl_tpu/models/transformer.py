@@ -89,11 +89,17 @@ class TransformerConfig:
     #: dense-model completion of the embedding layer's expert story
     #: (`parallel.embedding`). 0 = dense FFN. Experts do not split over the
     #: tp axis (attention still does); capacity-dropped tokens pass through
-    #: on the residual. v1 ships no load-balance aux loss (mechanics, not
-    #: recipe — the training-quality term is a straightforward follow-on).
+    #: on the residual; `moe_aux_weight` adds the load-balance term.
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
     expert_axis: str = "expert"
+    #: switch load-balance auxiliary loss weight (Shazeer/Fedus form:
+    #: E * sum_e f_e * p_e per layer, f = routed-token fraction, p = mean
+    #: router prob). 0 = off. Supported on non-pipelined meshes (the aux
+    #: scalar threads through the block scan's carry; threading it through
+    #: the pipeline hop buffers is future work — a nonzero weight with a
+    #: pipe axis raises rather than silently training a different loss).
+    moe_aux_weight: float = 0.0
 
     @property
     def head_dim(self) -> int:
@@ -184,6 +190,12 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
             f"moe_experts={E} must be divisible by "
             f"ep={_axis_size(mesh, cfg.expert_axis)}"
         )
+    if cfg.moe_aux_weight > 0 and _axis_size(mesh, cfg.pp_axis) > 1:
+        raise ValueError(
+            "moe_aux_weight > 0 is not supported with a pipe axis (the aux "
+            "scalar does not thread through the pipeline hop buffers); "
+            "train MoE on data x expert x model meshes or set it to 0"
+        )
     D, H, Dh, F, L, V = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
         cfg.vocab_size,
@@ -269,6 +281,12 @@ def _moe_ffn(cfg: TransformerConfig, mesh: Mesh, h: jax.Array, bp: dict):
     gate = probs.max(axis=-1)  # (T,)
     choice = probs.argmax(axis=-1)  # (T,)
     onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)  # (T, E)
+    # switch load-balance aux (differentiable through p, not f):
+    # E * sum_e f_e p_e is minimized (=1) by uniform routing
+    aux = E * jnp.sum(
+        jnp.mean(onehot.astype(jnp.float32), axis=0)
+        * jnp.mean(probs, axis=0)
+    )
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # slot index or -1
     keep = (pos >= 0) & (pos < cap)
     dispatch = (
@@ -295,7 +313,7 @@ def _moe_ffn(cfg: TransformerConfig, mesh: Mesh, h: jax.Array, bp: dict):
         )
     combine = dispatch * gate[:, None, None].astype(jnp.bfloat16)
     out = jnp.einsum("ecd,tec->td", down, combine)  # (T, D)
-    return out.reshape(B, S, D).astype(jnp.float32)
+    return out.reshape(B, S, D).astype(jnp.float32), aux
 
 
 def _block(cfg: TransformerConfig, mesh: Mesh, n_sp: int, x: jax.Array, bp: dict):
@@ -318,14 +336,15 @@ def _block(cfg: TransformerConfig, mesh: Mesh, n_sp: int, x: jax.Array, bp: dict
     out = _maybe_psum(out.astype(jnp.float32), mesh, cfg.tp_axis) + bp["bo"]
     x = x + out.astype(jnp.bfloat16)
     h = _rmsnorm(x, bp["ln2"])
+    aux = jnp.zeros((), jnp.float32)
     if cfg.moe_experts > 0:
-        o = _moe_ffn(cfg, mesh, h, bp)
+        o, aux = _moe_ffn(cfg, mesh, h, bp)
     else:
         f = jnp.einsum("bsd,df->bsf", h, bp["win"].astype(jnp.bfloat16))
         f = jax.nn.gelu(f + bp["bin"].astype(jnp.bfloat16))
         o = jnp.einsum("bsf,fd->bsd", f, bp["wout"].astype(jnp.bfloat16))
         o = _maybe_psum(o.astype(jnp.float32), mesh, cfg.tp_axis) + bp["bout"]
-    return x + o.astype(jnp.bfloat16)
+    return x + o.astype(jnp.bfloat16), aux
 
 
 def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
@@ -349,13 +368,30 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
         block_fn = jax.checkpoint(block_fn, prevent_cse=False)
 
     def stage(blocks_local, h):
-        """Apply this rank's chunk of blocks (whole stack when pp absent)."""
+        """Apply this rank's chunk of blocks — activation-only form for the
+        pipeline schedules (hop buffers carry activations; the per-block
+        aux scalar is dropped, which _init guards by rejecting a nonzero
+        moe_aux_weight on pipelined meshes)."""
         h, _ = jax.lax.scan(
-            lambda c, bp: (block_fn(c, bp), None),
+            lambda c, bp: (block_fn(c, bp)[0], None),
             h,
             blocks_local,
         )
         return h
+
+    def stage_with_aux(blocks_local, h):
+        """Whole-stack form: accumulates the MoE load-balance aux through
+        the scan carry alongside the activations."""
+
+        def body(carry, bp):
+            h, aux_acc = carry
+            h, aux = block_fn(h, bp)
+            return (h, aux_acc + aux), None
+
+        (h, aux), _ = jax.lax.scan(
+            body, (h, jnp.zeros((), jnp.float32)), blocks_local
+        )
+        return h, aux
 
     def tail_loss(lnf, head, y, tgt):
         """Final norm + LM head + mean token cross-entropy (f32)."""
@@ -393,8 +429,10 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
                 microbatches=cfg.microbatches or n_pp,
             )
         else:
-            x = stage(params["blocks"], x)
+            x, aux = stage_with_aux(params["blocks"], x)
         loss = tail_loss(params["lnf"], params["head"], x, targets)
+        if n_pp == 1 and cfg.moe_aux_weight > 0:
+            loss = loss + cfg.moe_aux_weight * aux / cfg.n_layers
     reduce_axes = (*present_axes(mesh, cfg.batch_axis),
                    *present_axes(mesh, cfg.seq_axis))
     return jax.lax.pmean(loss, reduce_axes) if reduce_axes else loss

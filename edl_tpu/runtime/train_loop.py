@@ -301,7 +301,7 @@ class Trainer:
             # caller having to thread the model/mesh through twice.
             if getattr(profiler, "model", None) is None:
                 profiler.model = self.model
-            if getattr(profiler, "n_chips", None) == 1:
+            if getattr(profiler, "n_chips", -1) is None:
                 profiler.n_chips = max(1, self.mesh.devices.size)
             profiler.start()
         for batch in batches:

@@ -82,7 +82,7 @@ class StepProfiler:
 
     def __init__(self, warmup: int = 1, window: int = 10_000,
                  sink: Optional[TextIO] = None, model: Optional[Any] = None,
-                 n_chips: int = 1):
+                 n_chips: Optional[int] = None):
         self.warmup = warmup
         self.window = window
         self.sink = sink
@@ -90,7 +90,9 @@ class StepProfiler:
         #: (models.base convention) the summary also reports achieved
         #: TFLOP/s per chip and MFU against the live chip's peak.
         self.model = model
-        self.n_chips = max(1, n_chips)
+        #: None = unset (Trainer.run fills it from its mesh); an explicit
+        #: value — including 1 for whole-job figures — is never overwritten.
+        self.n_chips = n_chips
         self.records: List[StepRecord] = []
         self._count = 0
         self._mark: Optional[float] = None
@@ -166,7 +168,8 @@ class StepProfiler:
             # batch_size=1 at the steady samples/s rate gives the achieved
             # figure. Only the non-null fields join the summary.
             acct = mfu_fields(self.model, 1, samples / total,
-                              n_chips=self.n_chips, device=jax.devices()[0])
+                              n_chips=self.n_chips or 1,
+                              device=jax.devices()[0])
             if acct.get("tflops_per_sec") is not None:
                 out["tflops_per_sec"] = acct["tflops_per_sec"]
             if acct.get("mfu") is not None:

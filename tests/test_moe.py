@@ -140,3 +140,46 @@ def test_indivisible_experts_raise():
     mesh = build_mesh(MeshSpec({"expert": 4, "data": 2}))
     with pytest.raises(ValueError, match="moe_experts"):
         transformer.make_model(bad).init(jax.random.PRNGKey(0), mesh)
+
+
+def test_aux_loss_value_and_training():
+    """Switch aux = E * sum_e f_e p_e: 1.0 at uniform routing, up to E when
+    collapsed. With the weight on, the loss carries the term and the model
+    still trains on the expert mesh."""
+    aux_cfg = dataclasses.replace(CFG, moe_aux_weight=0.05,
+                                  batch_axis=("data", "expert"),
+                                  moe_capacity_factor=2.0)
+    mesh = build_mesh(MeshSpec({"data": 2, "expert": 4}))
+    model = transformer.make_model(aux_cfg)
+    plain = transformer.make_model(dataclasses.replace(aux_cfg,
+                                                       moe_aux_weight=0.0))
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    batch = model.synthetic_batch(np.random.default_rng(0), 8)
+    placed = {
+        k: jax.device_put(
+            jnp.asarray(v),
+            jax.sharding.NamedSharding(mesh, model.batch_spec(mesh)[k]),
+        )
+        for k, v in batch.items()
+    }
+    l_aux = float(model.loss_fn(params, placed, mesh))
+    l_plain = float(plain.loss_fn(params, placed, mesh))
+    # the aux term is positive and bounded by weight * E
+    assert l_plain < l_aux <= l_plain + 0.05 * aux_cfg.moe_experts + 1e-4
+
+    trainer = Trainer(model, mesh,
+                      TrainerConfig(optimizer="adam", learning_rate=1e-3,
+                                    batch_axis=("data", "expert")))
+    state = trainer.init_state()
+    losses = []
+    for _ in range(6):
+        state, loss = trainer.train_step(state, trainer.place_batch(batch))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_aux_with_pipeline_raises():
+    bad = dataclasses.replace(CFG, moe_aux_weight=0.01)
+    mesh = build_mesh(MeshSpec({"pipe": 2, "data": 4}))
+    with pytest.raises(ValueError, match="moe_aux_weight"):
+        transformer.make_model(bad).init(jax.random.PRNGKey(0), mesh)
