@@ -183,3 +183,18 @@ def test_aux_with_pipeline_raises():
     mesh = build_mesh(MeshSpec({"pipe": 2, "data": 4}))
     with pytest.raises(ValueError, match="moe_aux_weight"):
         transformer.make_model(bad).init(jax.random.PRNGKey(0), mesh)
+
+
+def test_moe_composes_with_sequence_parallelism():
+    """Ring attention on the seq axis + expert dispatch on the expert axis
+    in one kernel — the composition must still match the oracle."""
+    cfg = dataclasses.replace(CFG, batch_axis=("data", "expert"))
+    batch = transformer.synthetic_batch(cfg, np.random.default_rng(0), 8)
+    l_ref, g_ref = _run({"data": 1}, cfg, batch, n_dev=1)
+    l_mix, g_mix = _run({"expert": 4, "seq": 2}, cfg, batch)
+    assert l_mix == pytest.approx(l_ref, rel=2e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_mix)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=8e-2, atol=1.5e-3)
