@@ -18,6 +18,7 @@ MFU uses the models' analytic accounting (`edl_tpu.tools.mfu`): causal-
 halved attention, train = 3x forward, remat recompute excluded.
 
 Env: EDL_LM_D_MODEL/LAYERS/HEADS/D_FF/SEQ/VOCAB/BATCH, EDL_LM_REMAT=1,
+EDL_LM_MOE=<experts> (bench a switch-MoE variant; 0 = dense),
 EDL_BENCH_WINDOWS/STEPS/PLATFORM as in bench.py. Prints one JSON line.
 """
 
@@ -55,6 +56,9 @@ def main() -> None:
         seq_len=env_int("EDL_LM_SEQ", 1024),
         vocab_size=env_int("EDL_LM_VOCAB", 32000),
         remat=os.environ.get("EDL_LM_REMAT") == "1",
+        # EDL_LM_MOE=8 benches a switch-MoE variant (single chip: experts
+        # colocated, still exercises routing/dispatch cost)
+        moe_experts=env_int("EDL_LM_MOE", 0),
     )
     batch_size = env_int("EDL_LM_BATCH", 8)
     windows = env_int("EDL_BENCH_WINDOWS", 5)

@@ -59,6 +59,12 @@ def parse_args():
                    help="pipeline microbatches (default: pipe size)")
     p.add_argument("--zero1", action="store_true",
                    help="shard optimizer moments over the data axis")
+    p.add_argument("--moe-experts", type=int, default=0,
+                   help="switch-routed experts over the expert axis "
+                        "(0 = dense FFN)")
+    p.add_argument("--moe-aux-weight", type=float, default=0.01,
+                   help="load-balance aux weight when --moe-experts > 0 "
+                        "(non-pipelined meshes)")
     p.add_argument("--num-passes", type=int,
                    default=os.environ.get("EDL_PASSES", "1"))
     return p.parse_args()
@@ -72,13 +78,24 @@ def main() -> None:
     # _build_mesh (same rule as ctr/train.py).
     axes = {k: int(v) for k, v in json.loads(args.axes).items()
             if k != "data" and int(v) > 1}
+    moe = int(args.moe_experts)
+    # aux loss does not thread through pipeline hop buffers (transformer
+    # validation rejects the combination) — drop it, not the run, when the
+    # user asked for MoE over a pipe axis without naming an aux weight
+    aux = args.moe_aux_weight if (moe and "pipe" not in axes) else 0.0
     model = transformer.make_model(
         vocab_size=args.vocab_size, d_model=args.d_model,
         n_layers=args.n_layers, n_heads=args.n_heads, d_ff=args.d_ff,
         seq_len=args.seq_len, remat=args.remat,
         pipeline_schedule=args.pipeline_schedule,
         microbatches=args.microbatches,
+        moe_experts=moe,
+        moe_aux_weight=aux,
+        # tokens shard over the expert axis too (the efficient layout)
+        batch_axis=("data", "expert") if moe else "data",
     )
+    if moe and "pipe" in axes and args.moe_aux_weight:
+        print("note: load-balance aux loss disabled on pipelined meshes")
     source = SyntheticShardSource(model, batch_size=args.batch_size,
                                   batches_per_shard=args.batches_per_shard)
 
@@ -114,7 +131,8 @@ def main() -> None:
         checkpoint_interval=ctx.checkpoint_interval,
         trainer=TrainerConfig(optimizer="adam",
                               learning_rate=args.learning_rate,
-                              shard_opt_state=args.zero1),
+                              shard_opt_state=args.zero1,
+                              batch_axis=model.config.batch_axis),
     )
     if ident is not None:
         from edl_tpu.runtime import MultiHostWorker
