@@ -92,6 +92,10 @@ class TransformerConfig:
     #: on the residual; `moe_aux_weight` adds the load-balance term.
     moe_experts: int = 0
     moe_capacity_factor: float = 1.25
+    #: experts per token: 1 = switch routing, 2 = GShard-style top-2 with
+    #: renormalized gates (choices slot in priority order — every token's
+    #: first choice outranks any second choice for capacity).
+    moe_top_k: int = 1
     expert_axis: str = "expert"
     #: switch load-balance auxiliary loss weight (Shazeer/Fedus form:
     #: E * sum_e f_e * p_e per layer, f = routed-token fraction, p = mean
@@ -190,6 +194,10 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
             f"moe_experts={E} must be divisible by "
             f"ep={_axis_size(mesh, cfg.expert_axis)}"
         )
+    if E > 0 and not 1 <= cfg.moe_top_k <= E:
+        raise ValueError(
+            f"moe_top_k={cfg.moe_top_k} must be in [1, moe_experts={E}]"
+        )
     if cfg.moe_aux_weight > 0 and _axis_size(mesh, cfg.pp_axis) > 1:
         raise ValueError(
             "moe_aux_weight > 0 is not supported with a pipe axis (the aux "
@@ -253,7 +261,10 @@ def _moe_ffn(cfg: TransformerConfig, mesh: Mesh, h: jax.Array, bp: dict):
     expert-sharded: (E_local, D, F) where E_local = E/ep. The classic
     einsum-dispatch formulation (Mesh-TensorFlow / Switch):
 
-      1. route: per-token top-1 expert + gate prob (f32 softmax);
+      1. route: per-token top-k experts (k=1 switch: gate = raw router
+         prob, its only gradient path; k>1 GShard: gates renormalized
+         over the surviving choices, first choices outranking seconds
+         for capacity);
       2. dispatch einsum packs each expert's first-C tokens into static
          (E, C, D) slots (capacity-dropped tokens contribute nothing and
          ride the residual unchanged);
@@ -271,28 +282,54 @@ def _moe_ffn(cfg: TransformerConfig, mesh: Mesh, h: jax.Array, bp: dict):
     E, F = cfg.moe_experts, cfg.d_ff
     ep = _axis_size(mesh, cfg.expert_axis)
     T = B * S
-    cap = max(1, math.ceil(T / E * cfg.moe_capacity_factor))
+    cap = max(1, math.ceil(cfg.moe_top_k * T / E * cfg.moe_capacity_factor))
     tok = h.reshape(T, D)
 
     logits = jnp.einsum(
         "td,de->te", tok.astype(jnp.float32), bp["router"]
     )  # (T, E) f32 — routing decisions deserve full precision
     probs = jax.nn.softmax(logits, axis=-1)
-    gate = probs.max(axis=-1)  # (T,)
-    choice = probs.argmax(axis=-1)  # (T,)
-    onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)  # (T, E)
-    # switch load-balance aux (differentiable through p, not f):
+    k = cfg.moe_top_k
+    # k successive argmaxes (masking each choice out) instead of top_k:
+    # the one-hots are needed anyway and the loop is tiny and static
+    remaining = probs
+    onehots, gates = [], []
+    for _ in range(k):
+        choice = remaining.argmax(axis=-1)  # (T,)
+        oh = jax.nn.one_hot(choice, E, dtype=jnp.int32)
+        onehots.append(oh)
+        gates.append(jnp.sum(probs * oh, axis=-1))
+        remaining = remaining * (1 - oh)
+    # switch load-balance aux on FIRST choices (the standard form):
     # E * sum_e f_e p_e is minimized (=1) by uniform routing
     aux = E * jnp.sum(
-        jnp.mean(onehot.astype(jnp.float32), axis=0)
+        jnp.mean(onehots[0].astype(jnp.float32), axis=0)
         * jnp.mean(probs, axis=0)
     )
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # slot index or -1
+    # capacity slots assigned in priority order: the cumsum runs over all
+    # first choices before any second choice, so an oversubscribed expert
+    # sheds k>1 traffic first (GShard semantics)
+    oh_all = jnp.concatenate(onehots, axis=0)  # (k*T, E)
+    pos = jnp.cumsum(oh_all, axis=0) * oh_all - 1  # slot index or -1
     keep = (pos >= 0) & (pos < cap)
-    dispatch = (
+    dispatch_all = (
         jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.bfloat16)
         * keep[..., None].astype(jnp.bfloat16)
-    )  # (T, E, C)
+    )  # (k*T, E, C)
+    alive = dispatch_all.sum(axis=(1, 2)).reshape(k, T)  # 1 if slotted
+    gate_k = jnp.stack(gates) * alive.astype(jnp.float32)  # (k, T)
+    if k > 1:
+        # GShard: renormalize over the surviving choices. NOT at k=1 —
+        # switch scales by the raw router prob (that product is the
+        # router's only gradient path; argmax has none).
+        gate_k = gate_k / jnp.maximum(gate_k.sum(axis=0, keepdims=True),
+                                      1e-9)
+    dispatch = dispatch_all.reshape(k, T, E, cap).sum(axis=0)  # (T, E, C)
+    combine_k = (
+        dispatch_all.reshape(k, T, E, cap)
+        * gate_k[:, :, None, None].astype(jnp.bfloat16)
+    )
+    combine = combine_k.sum(axis=0)  # (T, E, C)
 
     slots = jnp.einsum("tec,td->ecd", dispatch, tok)  # (E, C, D)
     if ep > 1:
@@ -311,7 +348,6 @@ def _moe_ffn(cfg: TransformerConfig, mesh: Mesh, h: jax.Array, bp: dict):
         down = jax.lax.all_to_all(
             down, cfg.expert_axis, split_axis=1, concat_axis=0, tiled=True
         )
-    combine = dispatch * gate[:, None, None].astype(jnp.bfloat16)
     out = jnp.einsum("ecd,tec->td", down, combine)  # (T, D)
     return out.reshape(B, S, D).astype(jnp.float32), aux
 
@@ -471,10 +507,13 @@ def _flops_per_step(cfg: TransformerConfig, batch_size: int) -> float:
     the LM head 2DV. Backward = 2x forward; remat recompute excluded.
     """
     D, F, L = cfg.d_model, cfg.d_ff, cfg.n_layers
-    # top-1 MoE: each token still visits ONE expert's 4DF FFN; the router
-    # matmul is the only extra (capacity-dropped tokens still count — MFU
-    # numerator convention, like remat).
-    ffn = 4 * D * F + (2 * D * cfg.moe_experts if cfg.moe_experts else 0)
+    # MoE: each token visits moe_top_k experts' 4DF FFNs plus the router
+    # matmul (capacity-dropped tokens still count — MFU numerator
+    # convention, like remat).
+    if cfg.moe_experts:
+        ffn = cfg.moe_top_k * 4 * D * F + 2 * D * cfg.moe_experts
+    else:
+        ffn = 4 * D * F
     per_token = (
         L * (6 * D * D + 2 * D * D + ffn + 0.5 * (4 * cfg.seq_len * D))
         + 2 * D * cfg.vocab_size

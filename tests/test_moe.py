@@ -198,3 +198,69 @@ def test_moe_composes_with_sequence_parallelism():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=8e-2, atol=1.5e-3)
+
+
+def test_top2_with_all_experts_equals_soft_mixture():
+    """E=2, k=2, no drops: every token visits both experts and the
+    renormalized gates are exactly the softmax probs — the layer must
+    equal the dense soft mixture computed directly from the weights."""
+    cfg = dataclasses.replace(CFG, moe_experts=2, moe_top_k=2,
+                              moe_capacity_factor=4.0)
+    mesh = build_mesh(MeshSpec({"data": 1}), jax.devices()[:1])
+    model = transformer.make_model(cfg)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    rng = np.random.default_rng(3)
+    h = jnp.asarray(rng.standard_normal((2, 16, cfg.d_model)), jnp.bfloat16)
+    bp = jax.tree_util.tree_map(lambda a: a[0], params["blocks"])
+
+    got, _ = transformer._moe_ffn(cfg, mesh, h, bp)
+
+    tok = h.reshape(-1, cfg.d_model)
+    probs = jax.nn.softmax(
+        jnp.einsum("td,de->te", tok.astype(jnp.float32), bp["router"]), -1
+    )
+    def expert(e):
+        up = tok @ bp["w_up"][e].astype(jnp.bfloat16) \
+            + bp["b_up"][e].astype(jnp.bfloat16)
+        return jax.nn.gelu(up) @ bp["w_down"][e].astype(jnp.bfloat16) \
+            + bp["b_down"][e].astype(jnp.bfloat16)
+    want = sum(probs[:, e:e + 1].astype(jnp.bfloat16) * expert(e)
+               for e in range(2)).reshape(got.shape)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               rtol=5e-2, atol=5e-2)
+
+
+def test_top2_layout_invariance_and_training():
+    cfg = dataclasses.replace(CFG, moe_top_k=2, moe_capacity_factor=8.0,
+                              batch_axis=("data", "expert"))
+    batch = transformer.synthetic_batch(cfg, np.random.default_rng(0), 8)
+    l_ref, g_ref = _run({"data": 1}, cfg, batch, n_dev=1)
+    l_ep, g_ep = _run({"data": 2, "expert": 4}, cfg, batch)
+    assert l_ep == pytest.approx(l_ref, rel=2e-2)
+    for a, b in zip(jax.tree_util.tree_leaves(g_ref),
+                    jax.tree_util.tree_leaves(g_ep)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=8e-2, atol=1.5e-3)
+
+    mesh = build_mesh(MeshSpec({"data": 2, "expert": 4}))
+    model = transformer.make_model(
+        dataclasses.replace(cfg, moe_capacity_factor=1.5))
+    trainer = Trainer(model, mesh,
+                      TrainerConfig(optimizer="adam", learning_rate=1e-3,
+                                    batch_axis=("data", "expert")))
+    state = trainer.init_state()
+    placed = trainer.place_batch(batch)
+    losses = []
+    for _ in range(6):
+        state, loss = trainer.train_step(state, placed)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_top_k_out_of_range_raises():
+    bad = dataclasses.replace(CFG, moe_top_k=5)  # > moe_experts=4
+    mesh = build_mesh(MeshSpec({"data": 8}))
+    with pytest.raises(ValueError, match="moe_top_k"):
+        transformer.make_model(bad).init(jax.random.PRNGKey(0), mesh)
