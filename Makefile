@@ -1,0 +1,22 @@
+# Developer entry points. The analyze target is the same command CI and
+# pre-commit run; exit 1 means new findings or stale baseline entries.
+
+PYTHON ?= python
+
+.PHONY: analyze analyze-json baseline test lint
+
+analyze:
+	$(PYTHON) -m edl_tpu.analysis edl_tpu
+
+analyze-json:
+	$(PYTHON) -m edl_tpu.analysis edl_tpu --format json
+
+## Regenerate accepted-debt baseline — only after consciously accepting or
+## fixing findings; the diff IS the review artifact.
+baseline:
+	$(PYTHON) -m edl_tpu.analysis edl_tpu --write-baseline
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+lint: analyze

@@ -1,0 +1,39 @@
+"""Domain-specific static analysis for the elastic-training codebase.
+
+Generic linters cannot see the invariants elastic training lives or dies
+by: shared controller/coordinator state mutated during a rescale must be
+lock-guarded (EDL001), the jitted hot path must not retrace or call back
+into the host (EDL002), PartitionSpec axis names must exist on the meshes
+we actually build (EDL003), coordinator handler paths must never block
+while holding the service lock (EDL004), and failures must not vanish into
+bare ``except`` handlers (EDL005). This package is an AST-based engine with
+one checker per invariant, a baseline file to ratchet existing debt down,
+and per-line suppression via ``# edl: noqa[RULE]``.
+
+Run it as ``python -m edl_tpu.analysis edl_tpu/`` or through
+``tests/test_analysis.py`` (tier-1: the committed tree must be clean
+against the committed baseline).
+"""
+
+from edl_tpu.analysis.core import Finding, SourceFile
+from edl_tpu.analysis.engine import AnalysisContext, Report, analyze
+from edl_tpu.analysis.baseline import (
+    Baseline,
+    apply_baseline,
+    fingerprint,
+    load_baseline,
+    write_baseline,
+)
+
+__all__ = [
+    "AnalysisContext",
+    "Baseline",
+    "Finding",
+    "Report",
+    "SourceFile",
+    "analyze",
+    "apply_baseline",
+    "fingerprint",
+    "load_baseline",
+    "write_baseline",
+]

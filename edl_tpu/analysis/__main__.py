@@ -1,0 +1,7 @@
+"""Entry point for ``python -m edl_tpu.analysis``."""
+
+import sys
+
+from edl_tpu.analysis.cli import main
+
+sys.exit(main())

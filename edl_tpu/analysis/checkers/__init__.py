@@ -1,0 +1,21 @@
+"""Checker registry: one module per EDL rule."""
+
+from edl_tpu.analysis.checkers.lock_discipline import LockDisciplineChecker
+from edl_tpu.analysis.checkers.trace_hygiene import TraceHygieneChecker
+from edl_tpu.analysis.checkers.sharding_consistency import (
+    ShardingConsistencyChecker,
+)
+from edl_tpu.analysis.checkers.blocking import BlockingInLockChecker
+from edl_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+
+ALL_CHECKERS = (
+    LockDisciplineChecker,
+    TraceHygieneChecker,
+    ShardingConsistencyChecker,
+    BlockingInLockChecker,
+    ExceptionHygieneChecker,
+)
+
+RULES = {c.rule: c for c in ALL_CHECKERS}
+
+__all__ = ["ALL_CHECKERS", "RULES"]

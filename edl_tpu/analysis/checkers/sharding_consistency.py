@@ -1,0 +1,193 @@
+"""EDL003 — PartitionSpec axis names must exist on the meshes we build.
+
+A ``PartitionSpec("modle")`` typo or an axis name no mesh constructor ever
+declares does not fail loudly — on a mesh without that axis JAX raises at
+placement time deep inside a rescale, or (worse, for optional axes resolved
+via ``present_axes``) silently falls back to replication and throws away
+the parallelism the spec asked for. ElasWave-style elastic correctness
+("consistent sharding across rescale") starts with a single source of truth
+for axis names: ``AXIS_ORDER`` in ``edl_tpu/parallel/mesh.py``.
+
+The checker collects axis-name candidates in ``parallel/`` and ``models/``
+files from:
+
+- string literals (and tuples of them) passed to ``P(...)`` /
+  ``PartitionSpec(...)``;
+- string defaults of parameters named ``axis``/``*_axis`` (tuple defaults
+  for ``*_axes``), including dataclass fields and module constants named
+  ``*_AXIS``;
+- ``axis_name=``/``axis=`` keywords and positional axis strings of the
+  named collectives (``psum``, ``all_gather``, ``ppermute``, ...).
+
+Every candidate must appear in the declared-axis universe parsed from
+``AXIS_ORDER``. Fixture trees can override the universe and scope via
+``config={"sharding_axes": [...], "sharding_all_files": True}``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Optional, Set, Tuple
+
+from edl_tpu.analysis.core import Finding, RuleInfo, SourceFile, dotted_name
+
+_SPEC_FUNCS = {"P", "PartitionSpec"}
+
+_COLLECTIVES = {
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "psum_scatter",
+    "all_gather",
+    "all_to_all",
+    "ppermute",
+    "pshuffle",
+    "axis_index",
+    "axis_size",
+}
+
+_AXIS_KEYWORDS = {"axis_name", "axis_names"}
+
+_MESH_RELPATH = os.path.join("edl_tpu", "parallel", "mesh.py")
+
+
+class ShardingConsistencyChecker:
+    rule = "EDL003"
+    name = "sharding-consistency"
+    info = RuleInfo(
+        rule="EDL003",
+        name="sharding-consistency",
+        description=(
+            "PartitionSpec / collective axis names used in parallel/ and "
+            "models/ must be declared by AXIS_ORDER in parallel/mesh.py"
+        ),
+    )
+
+    def check(self, sf: SourceFile, ctx) -> Iterator[Finding]:
+        if not self._applies(sf, ctx):
+            return
+        declared = self._declared_axes(ctx)
+        if declared is None:
+            return  # no mesh module (fixture tree without an override)
+        for name, node, where in self._candidates(sf.tree):
+            if name in declared:
+                continue
+            yield Finding(
+                rule=self.rule,
+                path=sf.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=(
+                    f"mesh axis '{name}' ({where}) is not declared in "
+                    "parallel/mesh.py AXIS_ORDER — a mesh built by this "
+                    "codebase never has it"
+                ),
+            )
+
+    # -- scope / config --------------------------------------------------------
+
+    @staticmethod
+    def _applies(sf: SourceFile, ctx) -> bool:
+        if ctx.config.get("sharding_all_files"):
+            return True
+        rel = sf.relpath
+        if rel.endswith("parallel/mesh.py"):
+            return False  # the declaration site itself
+        return "parallel/" in rel or "models/" in rel
+
+    def _declared_axes(self, ctx) -> Optional[Set[str]]:
+        override = ctx.config.get("sharding_axes")
+        if override is not None:
+            return set(override)
+        cached = ctx.cache.get("edl003_axes")
+        if cached is not None:
+            return cached
+        mesh_path = os.path.join(ctx.root, _MESH_RELPATH)
+        if not os.path.isfile(mesh_path):
+            return None
+        axes: Set[str] = set()
+        try:
+            with open(mesh_path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=mesh_path)
+        except SyntaxError:
+            return None
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "AXIS_ORDER"
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            axes.add(elt.value)
+        axes = axes or None
+        ctx.cache["edl003_axes"] = axes
+        return axes
+
+    # -- candidate collection --------------------------------------------------
+
+    def _candidates(
+        self, tree: ast.AST
+    ) -> Iterator[Tuple[str, ast.AST, str]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                yield from self._call_candidates(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._default_candidates(node)
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name) and self._axis_named(
+                    node.target.id
+                ):
+                    yield from self._string_values(
+                        node.value, f"field '{node.target.id}'"
+                    )
+            elif isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and self._axis_named(t.id):
+                        yield from self._string_values(
+                            node.value, f"constant '{t.id}'"
+                        )
+
+    @staticmethod
+    def _axis_named(name: str) -> bool:
+        low = name.lower()
+        return low == "axis" or low.endswith(("_axis", "_axes"))
+
+    def _call_candidates(self, node: ast.Call):
+        name = dotted_name(node.func)
+        base = name.split(".")[-1] if name else ""
+        if base in _SPEC_FUNCS:
+            for arg in node.args:
+                yield from self._string_values(arg, f"{base}(...) entry")
+        elif base in _COLLECTIVES:
+            # axis is the conventional second positional of lax collectives
+            for arg in node.args[1:]:
+                yield from self._string_values(arg, f"{base}(...) axis")
+        for kw in node.keywords:
+            if kw.arg in _AXIS_KEYWORDS:
+                yield from self._string_values(
+                    kw.value, f"{kw.arg}= of {base or 'call'}(...)"
+                )
+
+    def _default_candidates(self, fn: ast.AST):
+        args = fn.args
+        pos = args.posonlyargs + args.args
+        for arg, default in zip(pos[len(pos) - len(args.defaults):], args.defaults):
+            if self._axis_named(arg.arg):
+                yield from self._string_values(default, f"default of '{arg.arg}'")
+        for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+            if default is not None and self._axis_named(arg.arg):
+                yield from self._string_values(default, f"default of '{arg.arg}'")
+
+    @staticmethod
+    def _string_values(node: ast.AST, where: str):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value, node, where
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    yield elt.value, elt, where

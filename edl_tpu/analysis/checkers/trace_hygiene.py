@@ -1,0 +1,264 @@
+"""EDL002 — trace-hygiene inside jit/pjit/shard_map'd functions.
+
+The hot loop is one jitted step function; anything host-side that sneaks
+into its body either bakes a stale value into the compiled program
+(``time.time()``, ``np.random``), forces a device sync, or triggers silent
+retracing — the exact perf bugs the retrace canary in
+``runtime/train_loop.py`` catches at runtime. This checker catches them at
+review time.
+
+Traced functions are found per file:
+
+- ``@jax.jit`` / ``@pjit`` / ``@partial(jax.jit, ...)`` decorators;
+- local functions or lambdas passed to ``jax.jit(...)`` / ``pjit(...)`` /
+  ``shard_map(...)`` call sites anywhere in the file.
+
+Inside a traced body (nested defs included) it flags:
+
+- host clocks: ``time.time/perf_counter/monotonic/process_time/sleep``;
+- host RNG: ``np.random.*`` / ``numpy.random.*`` / stdlib ``random.*``
+  (``jax.random`` is fine — it is traced);
+- host callbacks: ``jax.pure_callback``, ``io_callback``,
+  ``host_callback.*``, ``jax.debug.callback``, plus ``print``/``input``/
+  ``breakpoint``;
+- value-dependent Python control flow: ``if``/``while`` tests that use a
+  traced parameter directly (``.shape``/``.ndim``/``.dtype`` accesses and
+  ``len``/``isinstance`` are static and allowed), and ``float()/int()/
+  bool()`` on a parameter (forces a blocking device sync).
+
+The parameter check is name-based and local — values laundered through
+assignments are not chased. That keeps every report actionable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from edl_tpu.analysis.core import Finding, RuleInfo, SourceFile, dotted_name
+
+_TRACERS = {"jit", "pjit", "shard_map"}
+
+_HOST_CLOCKS = {
+    "time.time",
+    "time.perf_counter",
+    "time.monotonic",
+    "time.process_time",
+    "time.sleep",
+}
+
+_HOST_CALLBACKS = {
+    "jax.pure_callback",
+    "pure_callback",
+    "jax.experimental.io_callback",
+    "io_callback",
+    "jax.debug.callback",
+}
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_FUNCS = {"len", "isinstance", "getattr", "hasattr", "type"}
+
+
+def _is_tracer(func: ast.AST) -> bool:
+    """True for ``jit``/``jax.jit``/``pjit``/``shard_map`` references."""
+    name = dotted_name(func)
+    if name is None:
+        return False
+    return name.split(".")[-1] in _TRACERS
+
+
+def _partial_of_tracer(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if name is None or name.split(".")[-1] != "partial":
+        return False
+    return bool(call.args) and _is_tracer(call.args[0])
+
+
+class TraceHygieneChecker:
+    rule = "EDL002"
+    name = "trace-hygiene"
+    info = RuleInfo(
+        rule="EDL002",
+        name="trace-hygiene",
+        description=(
+            "no host clocks, host RNG, host callbacks, or value-dependent "
+            "Python branching inside jit/pjit/shard_map traced functions"
+        ),
+    )
+
+    def check(self, sf: SourceFile, ctx) -> Iterator[Finding]:
+        for fn, how in self._traced_functions(sf.tree):
+            yield from self._check_traced(sf, fn, how)
+
+    # -- discovery -------------------------------------------------------------
+
+    def _traced_functions(self, tree: ast.AST):
+        defs = {}  # name -> innermost def seen (good enough per file)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+
+        seen: Set[int] = set()
+
+        def mark(fn: ast.AST, how: str):
+            if id(fn) not in seen:
+                seen.add(id(fn))
+                yield fn, how
+
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _is_tracer(dec) or (
+                        isinstance(dec, ast.Call)
+                        and (_is_tracer(dec.func) or _partial_of_tracer(dec))
+                    ):
+                        yield from mark(node, f"@{dotted_name(dec) or 'jit'}")
+            elif isinstance(node, ast.Call) and _is_tracer(node.func):
+                if not node.args:
+                    continue
+                target = node.args[0]
+                tracer = dotted_name(node.func) or "jit"
+                if isinstance(target, ast.Lambda):
+                    yield from mark(target, f"{tracer}(<lambda>)")
+                elif isinstance(target, ast.Name) and target.id in defs:
+                    yield from mark(defs[target.id], f"{tracer}({target.id})")
+
+    # -- body checks -----------------------------------------------------------
+
+    def _check_traced(
+        self, sf: SourceFile, fn: ast.AST, how: str
+    ) -> Iterator[Finding]:
+        fn_name = getattr(fn, "name", "<lambda>")
+        params = self._param_names(fn)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for node in body:
+            yield from self._walk(sf, node, fn_name, how, params)
+
+    @staticmethod
+    def _param_names(fn: ast.AST) -> Set[str]:
+        args = fn.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return {n for n in names if n != "self"}
+
+    def _walk(
+        self,
+        sf: SourceFile,
+        node: ast.AST,
+        fn_name: str,
+        how: str,
+        params: Set[str],
+    ) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Nested helpers are traced too; their params join the traced set.
+            inner = params | self._param_names(node)
+            for child in node.body:
+                yield from self._walk(sf, child, fn_name, how, inner)
+            return
+
+        if isinstance(node, ast.Call):
+            finding = self._check_call(sf, node, fn_name, how, params)
+            if finding is not None:
+                yield finding
+
+        if isinstance(node, (ast.If, ast.While)):
+            traced = self._traced_names_in(node.test, params)
+            if traced:
+                names = ", ".join(sorted(traced))
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield Finding(
+                    rule=self.rule,
+                    path=sf.relpath,
+                    line=node.test.lineno,
+                    col=node.test.col_offset,
+                    message=(
+                        f"Python `{kind}` on traced value(s) {names} inside "
+                        f"{how}-traced '{fn_name}' — use jax.lax.cond/while "
+                        "or hoist the branch out of the traced function"
+                    ),
+                )
+
+        for child in ast.iter_child_nodes(node):
+            yield from self._walk(sf, child, fn_name, how, params)
+
+    def _check_call(
+        self,
+        sf: SourceFile,
+        node: ast.Call,
+        fn_name: str,
+        how: str,
+        params: Set[str],
+    ) -> Optional[Finding]:
+        name = dotted_name(node.func)
+
+        def finding(msg: str) -> Finding:
+            return Finding(
+                rule=self.rule,
+                path=sf.relpath,
+                line=node.lineno,
+                col=node.col_offset,
+                message=msg + f" inside {how}-traced '{fn_name}'",
+            )
+
+        if name in _HOST_CLOCKS:
+            return finding(
+                f"host clock `{name}()` — its value is baked in at trace "
+                "time (and never updates across steps)"
+            )
+        if name is not None:
+            root = name.split(".")[0]
+            if (
+                name.startswith(("np.random.", "numpy.random.", "random."))
+                and root != "jax"
+            ):
+                return finding(
+                    f"host RNG `{name}()` — draws once at trace time; use "
+                    "jax.random with a threaded key"
+                )
+            if name in _HOST_CALLBACKS or root == "host_callback":
+                return finding(f"host callback `{name}(...)`")
+        if isinstance(node.func, ast.Name):
+            if node.func.id in {"print", "input", "breakpoint"}:
+                return finding(
+                    f"host call `{node.func.id}(...)` — use jax.debug.print "
+                    "for traced values"
+                )
+            if node.func.id in {"float", "int", "bool"} and any(
+                isinstance(a, ast.Name) and a.id in params for a in node.args
+            ):
+                return finding(
+                    f"`{node.func.id}()` on a traced parameter forces a "
+                    "blocking device sync"
+                )
+        return None
+
+    def _traced_names_in(self, test: ast.AST, params: Set[str]) -> Set[str]:
+        """Param names used by value (not just statically) in a test expr."""
+        traced: Set[str] = set()
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Attribute):
+                if node.attr in _STATIC_ATTRS:
+                    return  # x.shape / x.ndim / x.dtype are static
+                visit(node.value)
+                return
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                base = name.split(".")[-1] if name else ""
+                if base in _STATIC_FUNCS:
+                    return  # len(x), isinstance(x, T) are static
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                return
+            if isinstance(node, ast.Name) and node.id in params:
+                traced.add(node.id)
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(test)
+        return traced

@@ -1,0 +1,160 @@
+"""Analysis engine: walk files, run checkers, apply suppressions.
+
+The engine is deliberately boring: collect ``.py`` files, parse each once,
+hand the shared ``SourceFile`` to every enabled checker, and split raw
+findings into kept vs ``# edl: noqa``-suppressed. Baseline handling lives
+in ``baseline.py``; output formatting in ``cli.py``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+from edl_tpu.analysis.core import Finding, SourceFile
+
+_SKIP_DIRS = {
+    "__pycache__",
+    ".git",
+    ".hg",
+    "node_modules",
+    "native",
+    ".venv",
+    "venv",
+    ".eggs",
+    "build",
+    "dist",
+}
+
+
+@dataclass
+class AnalysisContext:
+    """Shared state handed to every checker.
+
+    ``root`` anchors cross-file lookups (EDL003 reads ``parallel/mesh.py``
+    relative to it); ``config`` carries per-run overrides (fixture axis
+    universes, scope widening); ``cache`` is scratch space checkers use to
+    avoid re-parsing shared inputs.
+    """
+
+    root: str
+    config: Dict[str, Any] = field(default_factory=dict)
+    cache: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class Report:
+    findings: List[Finding]
+    suppressed: List[Finding]
+    files_checked: int
+    parse_errors: List[Tuple[str, str]] = field(default_factory=list)
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    """Yield .py files under ``paths`` (files given directly always yield)."""
+    seen = set()
+    for path in paths:
+        path = os.path.abspath(path)
+        if os.path.isfile(path):
+            if path not in seen:
+                seen.add(path)
+                yield path
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    full = os.path.join(dirpath, name)
+                    if full not in seen:
+                        seen.add(full)
+                        yield full
+
+
+def detect_root(paths: Sequence[str]) -> str:
+    """Repo root: nearest ancestor of the first path that contains the
+    ``edl_tpu`` package (so EDL003 can find ``parallel/mesh.py``); falls
+    back to the CWD."""
+    for path in paths:
+        probe = os.path.abspath(path)
+        if os.path.isfile(probe):
+            probe = os.path.dirname(probe)
+        while True:
+            if os.path.isfile(
+                os.path.join(probe, "edl_tpu", "parallel", "mesh.py")
+            ):
+                return probe
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+    return os.getcwd()
+
+
+def analyze(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+    config: Optional[Dict[str, Any]] = None,
+) -> Report:
+    """Run the checker suite over ``paths``.
+
+    ``rules`` filters to a subset of rule ids (default: all). Findings on
+    ``# edl: noqa`` lines land in ``report.suppressed``; everything else in
+    ``report.findings`` (baseline application is the caller's business).
+    """
+    from edl_tpu.analysis.checkers import ALL_CHECKERS
+
+    root = os.path.abspath(root or detect_root(paths))
+    ctx = AnalysisContext(root=root, config=dict(config or {}))
+    wanted = {r.upper() for r in rules} if rules is not None else None
+    checkers = [
+        cls() for cls in ALL_CHECKERS if wanted is None or cls.rule in wanted
+    ]
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    errors: List[Tuple[str, str]] = []
+    n_files = 0
+    for path in iter_python_files(paths):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                sf = SourceFile(path, relpath, f.read())
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append((relpath, f"{type(e).__name__}: {e}"))
+            continue
+        n_files += 1
+        for checker in checkers:
+            for finding in checker.check(sf, ctx):
+                if not finding.symbol:
+                    finding = Finding(
+                        rule=finding.rule,
+                        path=finding.path,
+                        line=finding.line,
+                        col=finding.col,
+                        message=finding.message,
+                        symbol=sf.symbol_at(finding.line),
+                    )
+                if sf.is_suppressed(finding):
+                    suppressed.append(finding)
+                else:
+                    findings.append(finding)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    suppressed.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return Report(
+        findings=findings,
+        suppressed=suppressed,
+        files_checked=n_files,
+        parse_errors=errors,
+    )

@@ -67,21 +67,25 @@ class Controller:
     def start(self) -> "Controller":
         """Subscribe to the store (replaying existing jobs) and start the
         autoscaler loop — the two goroutines of the reference's Run."""
-        self._started = True
-        self._watcher = FuncWatcher(self.on_add, self.on_update, self.on_del)
-        self.store.watch(self._watcher, replay=True)
+        with self._lock:
+            self._started = True
+            watcher = FuncWatcher(self.on_add, self.on_update, self.on_del)
+            self._watcher = watcher
+        # Outside the lock: replay delivers on_add synchronously, and those
+        # callbacks re-enter self._lock to register updaters.
+        self.store.watch(watcher, replay=True)
         self.autoscaler.start()
         return self
 
     def stop(self) -> None:
-        self._started = False
-        if self._watcher is not None:
-            self.store.unwatch(self._watcher)
-            self._watcher = None
-        self.autoscaler.stop()
         with self._lock:
+            self._started = False
+            watcher, self._watcher = self._watcher, None
             updaters = list(self.updaters.values())
             self.updaters.clear()
+        if watcher is not None:
+            self.store.unwatch(watcher)
+        self.autoscaler.stop()
         for u in updaters:
             u.stop()
 

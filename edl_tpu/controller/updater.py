@@ -79,14 +79,14 @@ class JobUpdater:
     # -- external surface (ref: Notify/Modify/Delete, :88-97) ------------------
 
     def start(self) -> "JobUpdater":
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # edl: noqa[EDL001] started exactly once by the controller before the updater is shared
             target=self._run, name=f"edl-updater-{self.job.name}", daemon=True
         )
         self._thread.start()
         return self
 
     def notify_update(self, job: TrainingJob) -> None:
-        self.job.spec = job.spec  # actor thread reads it on next tick
+        self.job.spec = job.spec  # edl: noqa[EDL001] atomic reference swap under the GIL; the actor thread reads it on its next tick
         self._enqueue("update")
 
     def record_scale(self, record) -> None:

@@ -223,14 +223,14 @@ class K8sJobStore:
     def _relist(self) -> None:
         """List from scratch and emit the diff vs the cache (post-410 resync)."""
         data = self.api.get(self._watch_path)
-        self._resource_version = (data.get("metadata", {}) or {}).get(
-            "resourceVersion", ""
-        )
         fresh = {
             self._key(j.name, j.namespace): j
             for j in (from_crd(o) for o in data.get("items", []))
         }
         with self._lock:
+            self._resource_version = (data.get("metadata", {}) or {}).get(
+                "resourceVersion", ""
+            )
             old = self._cache
             self._cache = fresh
         for key, job in fresh.items():
@@ -272,7 +272,8 @@ class K8sJobStore:
         obj = event.get("object", {}) or {}
         rv = (obj.get("metadata", {}) or {}).get("resourceVersion")
         if rv:
-            self._resource_version = rv
+            with self._lock:
+                self._resource_version = rv
         kind = event.get("type")
         if kind == "BOOKMARK":
             # rv-progress marker (metadata-only object): advance the

@@ -40,7 +40,7 @@ from typing import Dict, Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from edl_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from edl_tpu.models.base import Model

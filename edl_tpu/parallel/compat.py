@@ -1,0 +1,36 @@
+"""JAX version compatibility shims for the parallel layer.
+
+``shard_map`` moved twice across the jax versions this repo targets:
+``jax.experimental.shard_map.shard_map`` (<= 0.4.x, replication check
+spelled ``check_rep``) → top-level ``jax.shard_map`` (>= 0.6, spelled
+``check_vma``). Callers write the modern spelling; this wrapper renames
+the kwarg to whatever the installed jax accepts, so the same source runs
+on both.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover - C-level signature
+    _PARAMS = frozenset()
+
+__all__ = ["shard_map"]
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kwargs):
+    if _PARAMS:
+        if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+            kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)

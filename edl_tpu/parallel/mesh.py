@@ -32,8 +32,13 @@ from jax.sharding import Mesh
 #: gradient reductions). Every other axis — model/seq tensor collectives,
 #: pipeline ppermutes, expert gathers — is latency-critical and stays inside
 #: one ICI slice, which `build_hierarchical_mesh` guarantees by construction
-#: (inner axes never straddle a slice boundary).
-AXIS_ORDER = ("dcn", "data", "seq", "expert", "model")
+#: (inner axes never straddle a slice boundary). "pipe" sits between data
+#: and the tensor axes: stage ppermutes fire once per microbatch (more
+#: latency-tolerant than per-layer model/seq collectives, less than
+#: once-per-step data psums). This tuple is also the declared axis-name
+#: universe the EDL003 sharding-consistency check validates PartitionSpecs
+#: against (edl_tpu/analysis).
+AXIS_ORDER = ("dcn", "data", "pipe", "seq", "expert", "model")
 
 
 @dataclass(frozen=True)
@@ -101,7 +106,7 @@ def arrange_devices(devs: Sequence, shape: Sequence[int]) -> np.ndarray:
             return mesh_utils.create_device_mesh(
                 tuple(shape), devices=devs, allow_split_physical_axes=True
             )
-        except Exception:  # non-grid accelerator kinds: fall through
+        except Exception:  # edl: noqa[EDL005] non-grid accelerator kinds fall back to the row-major layout below; nothing is lost
             pass
     order = sorted(
         range(len(devs)),

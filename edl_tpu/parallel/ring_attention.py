@@ -29,7 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from edl_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 #: scores below this are "masked"; finite so exp() is exactly 0 without nans.

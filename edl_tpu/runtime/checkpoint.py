@@ -16,12 +16,15 @@ checkpoint-restore. This module wraps orbax:
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Optional
 
 import jax
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+log = logging.getLogger("edl_tpu.checkpoint")
 
 
 def live_state_specs(state: Any) -> Any:
@@ -127,7 +130,15 @@ class Checkpointer:
                 step, args=ocp.args.Composite(extra=ocp.args.JsonRestore())
             )
             return out.get("extra")
-        except Exception:
+        except Exception as e:
+            # Extra metadata is optional (older checkpoints have none), but
+            # a failed read must not be invisible: the caller falls back to
+            # defaults (data-shard offsets, wire-codec floors), and a
+            # swallowed error here would make that fallback look deliberate.
+            log.warning(
+                "restore_extra at step %s failed; continuing without extra "
+                "metadata: %s", step, e
+            )
             return None
 
     def close(self) -> None:

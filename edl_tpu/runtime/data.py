@@ -236,7 +236,7 @@ def prefetch_iter(it: Iterator, depth: int = 2) -> Iterator:
                 if not put(("item", item)):
                     return
             put(("end", None))
-        except BaseException as e:  # noqa: BLE001 — relayed, not swallowed
+        except BaseException as e:  # edl: noqa[EDL005] relayed, not swallowed: the consumer re-raises it from the queue
             put(("err", e))
 
     t = _threading.Thread(target=pump, daemon=True, name="edl-batch-prefetch")

@@ -189,6 +189,9 @@ def _write_artifact(directory, model_ref, host_flat, config, step) -> None:
             os.unlink(os.path.join(directory, stale))
     # Sweep orphaned mkstemp leftovers (a writer that died between mkstemp
     # and os.replace); age-gated so a concurrent writer's live tmp survives.
+    # Intentionally host-side wall clock (EDL002 does not apply: this runs
+    # after the collective gather, never under a trace) — mtime comparison
+    # needs the same epoch clock os.path.getmtime reports.
     now = time.time()
     for p in os.listdir(directory):
         if p.endswith((".npz.tmp", ".json.tmp")):

@@ -13,6 +13,7 @@ optimizer state update in-place in HBM.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, NamedTuple, Optional, Tuple
@@ -25,6 +26,8 @@ from jax.sharding import Mesh
 
 from edl_tpu.models.base import Model
 from edl_tpu.parallel.sharding import shard_batch
+
+log = logging.getLogger("edl_tpu.trainer")
 
 
 class TrainState(NamedTuple):
@@ -127,6 +130,13 @@ class Trainer:
         self._jit_step = jax.jit(_step, donate_argnums=(0,))
         self._codec = None  # negotiated on first place_batch when wire_transport
         self._jit_step_wire = None
+        #: retracing canary (the runtime complement of the EDL002 static
+        #: check): cumulative count of step-function recompiles after the
+        #: expected first-step compile. Nonzero means shape/dtype churn in
+        #: the input pipeline is silently burning compile time every step.
+        self.retraces = 0
+        self._compiles_seen: Optional[int] = None
+        self._warmed = False  # set once the jit cache holds steady one step
 
     # -- state -----------------------------------------------------------------
 
@@ -205,9 +215,7 @@ class Trainer:
             # channel the only safe transport is raw.
             if not getattr(self, "_warned_wire_multiproc", False):
                 self._warned_wire_multiproc = True
-                import logging
-
-                logging.getLogger("edl_tpu.trainer").warning(
+                log.warning(
                     "wire_transport disabled: multi-process jobs need a "
                     "codec_channel (KVCodecChannel) for a globally agreed codec"
                 )
@@ -273,6 +281,59 @@ class Trainer:
             return self._jit_step_wire(state, batch)
         return self._jit_step(state, batch)
 
+    # -- retracing canary ------------------------------------------------------
+
+    def _jit_cache_size(self) -> Optional[int]:
+        """Total compiled-program count across the step jits (None when the
+        private ``_cache_size`` API is unavailable on this JAX version)."""
+        total = 0
+        for fn in (self._jit_step, self._jit_step_wire):
+            if fn is None:
+                continue
+            cache_size = getattr(fn, "_cache_size", None)
+            if cache_size is None:
+                return None
+            try:
+                total += int(cache_size())
+            except Exception:  # edl: noqa[EDL005] observability probe on a private API; a broken probe must not fail the step
+                return None
+        return total
+
+    def check_retrace(self, step: int) -> bool:
+        """Record whether the step function recompiled since the last call.
+
+        Warmup self-detects: cache growth is absorbed silently until the
+        cache holds steady across one step (the step-1 compile, plus the
+        legitimate second program when donated outputs commit a sharding
+        the freshly-placed init state didn't have). After that first
+        stable step, any growth is a retrace — logged loudly, counted in
+        ``self.retraces``, and surfaced in ``run()`` metrics. A wire-codec
+        widening rebuilds ``_jit_step_wire`` and legitimately shrinks the
+        cache; the baseline just resets (and re-warms).
+        """
+        total = self._jit_cache_size()
+        if total is None:
+            return False
+        if self._compiles_seen is None or total < self._compiles_seen:
+            self._compiles_seen = total
+            self._warmed = False
+            return False
+        if total == self._compiles_seen:
+            self._warmed = True
+            return False
+        grew = total - self._compiles_seen
+        self._compiles_seen = total
+        if self._warmed and step > 1:
+            self.retraces += grew
+            log.warning(
+                "train step RECOMPILED at step %d (%d new program(s), "
+                "jit cache now %d) — shape/dtype churn in the input "
+                "pipeline is spending compile time inside the hot loop",
+                step, grew, total,
+            )
+            return True
+        return False
+
     def run(
         self,
         state: TrainState,
@@ -310,6 +371,7 @@ class Trainer:
             samples += len(first)
             state, loss = self.train_step(state, placed)
             n += 1
+            self.check_retrace(n)
             if on_step is not None:
                 on_step(n, float(loss))
             if profiler is not None:
@@ -325,5 +387,6 @@ class Trainer:
             "mean_loss": float(np.mean(losses)) if losses else float("nan"),
             "samples_per_sec": samples / elapsed,
             "seconds": elapsed,
+            "retraces": float(self.retraces),
         }
         return state, metrics

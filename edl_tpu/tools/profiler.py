@@ -194,7 +194,7 @@ def trace(logdir: str):
     try:
         cm = jax.profiler.trace(logdir)
         cm.__enter__()
-    except Exception:  # pragma: no cover - profiler unavailable/double-start
+    except Exception:  # pragma: no cover  # edl: noqa[EDL005] degrade to no-op: a backend without profiler support must not kill training
         cm = None
     try:
         yield
@@ -202,7 +202,7 @@ def trace(logdir: str):
         if cm is not None:
             try:
                 cm.__exit__(None, None, None)
-            except Exception:  # pragma: no cover
+            except Exception:  # pragma: no cover  # edl: noqa[EDL005] trace teardown is best-effort; errors from the traced block propagate separately
                 pass
 
 
@@ -229,7 +229,7 @@ def device_memory_stats() -> Dict[str, Dict[str, int]]:
     for d in jax.local_devices():
         try:
             stats = d.memory_stats()
-        except Exception:
+        except Exception:  # edl: noqa[EDL005] backends without memory_stats (CPU tests) report {}; that absence is the signal
             stats = None
         if stats:
             out[str(d.id)] = {k: int(v) for k, v in stats.items()

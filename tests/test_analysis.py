@@ -1,0 +1,648 @@
+"""Tests for the edl_tpu.analysis static-analysis suite.
+
+Three layers:
+
+- per-rule fixture pairs: every EDL rule has at least one snippet that
+  triggers it and one that must NOT (the false-positive guard matters as
+  much as the detection — a noisy checker gets noqa'd into oblivion);
+- mechanism tests: suppression comments, baseline round-trip + ratchet,
+  CLI exit codes;
+- the repo gate: the committed tree must be clean against the committed
+  baseline. This is the tier-1 teeth of the whole suite.
+"""
+
+import json
+import logging
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from edl_tpu.analysis import (
+    analyze,
+    apply_baseline,
+    fingerprint,
+    load_baseline,
+    write_baseline,
+)
+from edl_tpu.analysis.cli import main as cli_main
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+def check(tmp_path, source, rules, name="snippet.py", config=None):
+    """Analyze one dedented snippet with a rule subset; return the Report."""
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return analyze([str(p)], root=str(tmp_path), rules=rules, config=config)
+
+
+def rules_of(report):
+    return [f.rule for f in report.findings]
+
+
+# -- EDL001: lock discipline ---------------------------------------------------
+
+
+def test_edl001_flags_unlocked_write(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = 0
+
+            def bump(self):
+                self.value += 1
+        """,
+        ["EDL001"],
+    )
+    assert rules_of(report) == ["EDL001"]
+    (f,) = report.findings
+    assert "value" in f.message and f.symbol.endswith("bump")
+
+
+def test_edl001_accepts_locked_write_and_locked_helper(tmp_path):
+    """Writes under `with self._lock` pass — including writes in a private
+    helper only ever called while the lock is held (call-graph, not just
+    lexical scope)."""
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.value = 0
+
+            def bump(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self.value += 1
+        """,
+        ["EDL001"],
+    )
+    assert report.findings == []
+
+
+def test_edl001_thread_target_escape_makes_private_method_an_entry(tmp_path):
+    """`Thread(target=self._run)` publishes _run to another thread: its
+    writes need the lock even though no public method calls it."""
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.ticks = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.ticks += 1
+        """,
+        ["EDL001"],
+    )
+    assert rules_of(report) == ["EDL001"]
+    assert report.findings[0].symbol.endswith("_run")
+
+
+def test_edl001_ignores_lockless_classes(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        class Plain:
+            def __init__(self):
+                self.value = 0
+
+            def bump(self):
+                self.value += 1
+        """,
+        ["EDL001"],
+    )
+    assert report.findings == []
+
+
+# -- EDL002: trace hygiene -----------------------------------------------------
+
+
+def test_edl002_flags_host_clock_in_jitted_fn(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x * time.time()
+        """,
+        ["EDL002"],
+    )
+    assert rules_of(report) == ["EDL002"]
+    assert "time.time" in report.findings[0].message
+
+
+def test_edl002_flags_branch_on_traced_value(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def relu_ish(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        ["EDL002"],
+    )
+    assert rules_of(report) == ["EDL002"]
+
+
+def test_edl002_allows_static_shape_branch_and_host_code(tmp_path):
+    """Branching on .shape/.ndim is static (fine under jit); host-side
+    time.time() outside any traced function is the normal case."""
+    report = check(
+        tmp_path,
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def maybe_sum(x):
+            if x.ndim > 1:
+                return x.sum()
+            return x
+
+        def host_timer():
+            return time.time()
+        """,
+        ["EDL002"],
+    )
+    assert report.findings == []
+
+
+def test_edl002_finds_fn_passed_to_jit_call(tmp_path):
+    """jit used as a call, not a decorator: jax.jit(step) marks step."""
+    report = check(
+        tmp_path,
+        """
+        import numpy as np
+        import jax
+
+        def step(x):
+            return x + np.random.rand()
+
+        fast_step = jax.jit(step)
+        """,
+        ["EDL002"],
+    )
+    assert rules_of(report) == ["EDL002"]
+    assert "np.random" in report.findings[0].message
+
+
+# -- EDL003: sharding consistency ---------------------------------------------
+
+_EDL003_CONFIG = {
+    "sharding_axes": ["data", "model"],
+    "sharding_all_files": True,
+}
+
+
+def test_edl003_flags_undeclared_axis(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P("data", "modle")
+        """,
+        ["EDL003"],
+        config=_EDL003_CONFIG,
+    )
+    assert rules_of(report) == ["EDL003"]
+    assert "'modle'" in report.findings[0].message
+
+
+def test_edl003_accepts_declared_axes_and_collective_kwargs(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        SPEC = P(("data",), "model")
+
+        def reduce_loss(loss, batch_axis: str = "data"):
+            return jax.lax.psum(loss, axis_name=batch_axis)
+        """,
+        ["EDL003"],
+        config=_EDL003_CONFIG,
+    )
+    assert report.findings == []
+
+
+def test_edl003_flags_bad_axis_default(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def shard(x, shard_axis: str = "experts"):
+            return x
+        """,
+        ["EDL003"],
+        config=_EDL003_CONFIG,
+    )
+    assert rules_of(report) == ["EDL003"]
+
+
+def test_edl003_scope_is_parallel_and_models_by_default(tmp_path):
+    """Without the all-files override, only parallel/ and models/ paths are
+    in scope — examples and tests may name foreign axes freely."""
+    (tmp_path / "examples").mkdir()
+    (tmp_path / "examples" / "demo.py").write_text(
+        'from jax.sharding import PartitionSpec as P\nS = P("zzz")\n'
+    )
+    report = analyze(
+        [str(tmp_path / "examples")],
+        root=str(tmp_path),
+        rules=["EDL003"],
+        config={"sharding_axes": ["data"]},
+    )
+    assert report.findings == []
+
+
+# -- EDL004: blocking while holding a lock ------------------------------------
+
+
+def test_edl004_flags_sleep_under_lock(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def handle(self):
+                with self._lock:
+                    time.sleep(0.1)
+        """,
+        ["EDL004"],
+    )
+    assert rules_of(report) == ["EDL004"]
+    assert "time.sleep" in report.findings[0].message
+
+
+def test_edl004_allows_sleep_outside_lock(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class Server:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def handle(self):
+                with self._lock:
+                    self.n += 1
+                time.sleep(0.1)
+        """,
+        ["EDL004"],
+    )
+    assert report.findings == []
+
+
+def test_edl004_flags_subprocess_under_module_lock(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import subprocess
+        import threading
+
+        _cache_lock = threading.Lock()
+
+        def refresh():
+            with _cache_lock:
+                subprocess.run(["kubectl", "get", "pods"])
+        """,
+        ["EDL004"],
+    )
+    assert rules_of(report) == ["EDL004"]
+
+
+# -- EDL005: exception hygiene -------------------------------------------------
+
+
+def test_edl005_flags_silent_broad_except(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def load():
+            try:
+                risky()
+            except Exception:
+                pass
+        """,
+        ["EDL005"],
+    )
+    assert rules_of(report) == ["EDL005"]
+
+
+def test_edl005_accepts_logged_reraised_or_narrow(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import logging
+
+        log = logging.getLogger(__name__)
+
+        def logged():
+            try:
+                risky()
+            except Exception:
+                log.exception("risky failed")
+
+        def reraised():
+            try:
+                risky()
+            except Exception as e:
+                raise RuntimeError("wrapped") from e
+
+        def narrow():
+            try:
+                risky()
+            except ValueError:
+                pass
+
+        def delegated(e=None):
+            try:
+                risky()
+            except Exception as e:
+                _warn_failure(e)
+        """,
+        ["EDL005"],
+    )
+    assert report.findings == []
+
+
+# -- suppression comments ------------------------------------------------------
+
+
+def test_noqa_suppresses_exact_rule_on_exact_line(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def load():
+            try:
+                risky()
+            except Exception:  # edl: noqa[EDL005] probe result is optional
+                pass
+        """,
+        ["EDL005"],
+    )
+    assert report.findings == []
+    assert rules_of(report) == [] and len(report.suppressed) == 1
+    assert report.suppressed[0].rule == "EDL005"
+
+
+def test_noqa_for_wrong_rule_does_not_suppress(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def load():
+            try:
+                risky()
+            except Exception:  # edl: noqa[EDL001] wrong rule entirely
+                pass
+        """,
+        ["EDL005"],
+    )
+    assert rules_of(report) == ["EDL005"]
+
+
+def test_blanket_noqa_suppresses_any_rule(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def load():
+            try:
+                risky()
+            except Exception:  # edl: noqa
+                pass
+        """,
+        ["EDL005"],
+    )
+    assert report.findings == [] and len(report.suppressed) == 1
+
+
+# -- baseline round-trip and ratchet ------------------------------------------
+
+_BAD_EDL005 = """
+def load():
+    try:
+        risky()
+    except Exception:
+        pass
+"""
+
+
+def test_baseline_round_trip_accepts_then_goes_stale(tmp_path):
+    report = check(tmp_path, _BAD_EDL005, ["EDL005"])
+    assert len(report.findings) == 1
+
+    bpath = tmp_path / "baseline.json"
+    write_baseline(str(bpath), report.findings)
+    baseline = load_baseline(str(bpath))
+    assert baseline.total() == 1
+
+    # same tree: the finding is accepted, nothing new, nothing stale
+    new, accepted, stale = apply_baseline(report.findings, baseline)
+    assert (new, stale) == ([], []) and len(accepted) == 1
+
+    # debt fixed: the entry turns stale (which also fails the run — the
+    # ratchet only ever tightens)
+    fixed = check(tmp_path, "def load():\n    return risky()\n", ["EDL005"])
+    new, accepted, stale = apply_baseline(fixed.findings, baseline)
+    assert new == [] and accepted == []
+    assert len(stale) == 1 and stale[0]["rule"] == "EDL005"
+
+
+def test_baseline_count_caps_identical_findings(tmp_path):
+    """Two identical findings in one symbol share a fingerprint; the count
+    caps acceptance, so a third occurrence is new debt."""
+    one = check(tmp_path, _BAD_EDL005, ["EDL005"])
+    baseline = load_baseline(
+        str(write_baseline_to(tmp_path, one.findings))
+    )
+    doubled = check(
+        tmp_path,
+        """
+        def load():
+            try:
+                risky()
+        """
+        + "    except Exception:\n        pass\n" * 0
+        + """
+            except Exception:
+                pass
+            try:
+                risky()
+            except Exception:
+                pass
+        """,
+        ["EDL005"],
+    )
+    assert len(doubled.findings) == 2
+    assert fingerprint(doubled.findings[0]) == fingerprint(doubled.findings[1])
+    new, accepted, stale = apply_baseline(doubled.findings, baseline)
+    assert len(accepted) == 1 and len(new) == 1 and stale == []
+
+
+def write_baseline_to(tmp_path, findings):
+    bpath = tmp_path / "baseline.json"
+    write_baseline(str(bpath), findings)
+    return bpath
+
+
+def test_baseline_rejects_unknown_version(tmp_path):
+    bpath = tmp_path / "baseline.json"
+    bpath.write_text(json.dumps({"version": 99, "findings": {}}))
+    with pytest.raises(ValueError):
+        load_baseline(str(bpath))
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_json_shape(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(_BAD_EDL005))
+
+    rc = cli_main([str(bad), "--format", "json", "--baseline", "none"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["summary"]["new"] == 1
+    assert payload["findings"][0]["rule"] == "EDL005"
+    assert payload["findings"][0]["baselined"] is False
+
+    # baseline it: same tree now exits 0 and reports it as baselined
+    bpath = tmp_path / "baseline.json"
+    rc = cli_main([str(bad), "--baseline", str(bpath), "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli_main([str(bad), "--format", "json", "--baseline", str(bpath)])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["summary"] == dict(
+        payload["summary"], new=0, baselined=1
+    )
+
+
+def test_cli_clean_tree_exits_zero(tmp_path, capsys):
+    good = tmp_path / "good.py"
+    good.write_text("def f():\n    return 1\n")
+    rc = cli_main([str(good), "--baseline", "none"])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_parse_error_exits_two(tmp_path, capsys):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    rc = cli_main([str(broken), "--baseline", "none"])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_cli_list_rules_names_all_five(capsys):
+    rc = cli_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rule in ("EDL001", "EDL002", "EDL003", "EDL004", "EDL005"):
+        assert rule in out
+
+
+def test_module_entrypoint_runs():
+    """`python -m edl_tpu.analysis --list-rules` — the CI/pre-commit form."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "edl_tpu.analysis", "--list-rules"],
+        cwd=str(REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "EDL001" in proc.stdout
+
+
+# -- the repo gate -------------------------------------------------------------
+
+
+def test_repo_tree_is_clean_against_committed_baseline():
+    """Tier-1 teeth: the committed tree carries zero non-baselined findings
+    and zero stale baseline entries. New debt → fix it, noqa it with a
+    justification, or consciously --write-baseline."""
+    report = analyze([str(REPO_ROOT / "edl_tpu")], root=str(REPO_ROOT))
+    assert report.parse_errors == [], report.parse_errors
+    baseline = load_baseline(str(REPO_ROOT / "analysis_baseline.json"))
+    new, _accepted, stale = apply_baseline(report.findings, baseline)
+    assert new == [], "new findings:\n" + "\n".join(
+        f"{f.location()}: {f.rule} {f.message}" for f in new
+    )
+    assert stale == [], "stale baseline entries (run --write-baseline):\n" + "\n".join(
+        f"{e['rule']} {e['path']} '{e['symbol']}'" for e in stale
+    )
+
+
+# -- retrace canary (runtime complement of EDL002) ----------------------------
+
+
+def test_retrace_canary_counts_recompiles(caplog):
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.parallel import local_mesh
+    from edl_tpu.runtime import Trainer, TrainerConfig
+
+    mesh = local_mesh()
+    trainer = Trainer(
+        fit_a_line.MODEL, mesh, TrainerConfig(optimizer="sgd", learning_rate=0.1)
+    )
+    state = trainer.init_state()
+    rng = np.random.default_rng(5)
+
+    def batches(n, bs):
+        for _ in range(n):
+            yield fit_a_line.MODEL.synthetic_batch(rng, bs)
+
+    state, metrics = trainer.run(state, batches(3, 64))
+    if trainer._jit_cache_size() is None:
+        pytest.skip("jit _cache_size() unavailable on this jax version")
+    # steady shapes: the one compile at step 1 is not a retrace
+    assert metrics["retraces"] == 0.0
+    assert trainer.retraces == 0
+
+    # a changed batch shape forces a recompile — the canary must see it
+    batch = fit_a_line.MODEL.synthetic_batch(rng, 32)
+    state, _ = trainer.train_step(state, trainer.place_batch(batch))
+    with caplog.at_level(logging.WARNING, logger="edl_tpu.trainer"):
+        tripped = trainer.check_retrace(step=4)
+    assert tripped is True
+    assert trainer.retraces >= 1
+    assert any("RECOMPILED" in r.message for r in caplog.records)
