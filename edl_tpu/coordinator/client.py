@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -32,6 +33,13 @@ class CoordinatorClient:
     """One persistent connection; requests are serialized (1 req -> 1 reply),
     except ``barrier`` which blocks until the coordinator releases it.
 
+    Thread-safe at request granularity: a lock serializes each call's full
+    send→recv transaction, so the pipelined data path (`DevicePrefetcher`
+    running `LeaseReader` RPCs on a pump thread) can share the client with
+    the main loop's heartbeats. Requests from different threads queue
+    behind each other — a thread parked in ``barrier``/``sync`` blocks
+    other callers, so long rendezvous belong on a dedicated client.
+
     ``token`` is the per-job shared secret (default: the pod env's
     EDL_COORD_TOKEN, stamped by the controller — jobparser.make_env); it
     rides every request. Auth-rejected calls raise CoordinatorAuthError.
@@ -47,6 +55,11 @@ class CoordinatorClient:
             else os.environ.get("EDL_COORD_TOKEN", "")
         self._sock: Optional[socket.socket] = None
         self._buf = b""
+        #: serializes one full request/reply transaction per call() — the
+        #: socket and _buf pair replies to requests by ordering, so
+        #: interleaved sends from two threads would cross-deliver replies.
+        #: RLock: call()'s error paths close() while already holding it.
+        self._lock = threading.RLock()
         self._connect(connect_timeout)
 
     def _connect(self, timeout: float) -> None:
@@ -67,11 +80,12 @@ class CoordinatorClient:
         )
 
     def close(self) -> None:
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            finally:
-                self._sock = None
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
 
     def __enter__(self):
         return self
@@ -82,36 +96,42 @@ class CoordinatorClient:
     # -- protocol --------------------------------------------------------------
 
     def call(self, op: str, timeout: Optional[float] = None, **fields) -> Dict:
-        if self._sock is None:
-            # A previous timeout/error poisoned the connection (a late reply
-            # may still be in flight, which would desync request/reply
-            # pairing) — start a fresh one.
-            self._buf = b""
-            self._connect(5.0)
-        req = {"op": op, **fields}
-        if self.worker and "worker" not in req:
-            req["worker"] = self.worker
-        if self.token and "token" not in req:
-            req["token"] = self.token
-        payload = (json.dumps(req, ensure_ascii=False) + "\n").encode()
-        self._sock.settimeout(timeout)
-        try:
-            self._sock.sendall(payload)
-            while b"\n" not in self._buf:
-                chunk = self._sock.recv(65536)
-                if not chunk:
-                    raise CoordinatorError("coordinator closed connection")
-                self._buf += chunk
-        except socket.timeout as e:
-            self.close()  # poison: the reply may arrive later on this socket
-            raise CoordinatorError(f"coordinator call {op!r} timed out") from e
-        except OSError as e:
-            self.close()
-            raise CoordinatorError(f"coordinator call {op!r} failed: {e}") from e
-        finally:
-            if self._sock is not None:
-                self._sock.settimeout(None)
-        line, self._buf = self._buf.split(b"\n", 1)
+        # The lock intentionally spans the socket round-trip: this is a
+        # CLIENT connection whose replies pair to requests by ordering, so
+        # the transaction must be atomic per thread — unlike the
+        # coordinator's service lock, nothing latency-critical serializes
+        # behind it except other requests on this same connection.
+        with self._lock:
+            if self._sock is None:
+                # A previous timeout/error poisoned the connection (a late
+                # reply may still be in flight, which would desync
+                # request/reply pairing) — start a fresh one.
+                self._buf = b""
+                self._connect(5.0)
+            req = {"op": op, **fields}
+            if self.worker and "worker" not in req:
+                req["worker"] = self.worker
+            if self.token and "token" not in req:
+                req["token"] = self.token
+            payload = (json.dumps(req, ensure_ascii=False) + "\n").encode()
+            self._sock.settimeout(timeout)
+            try:
+                self._sock.sendall(payload)  # edl: noqa[EDL004] client request/reply transaction — the lock exists to make exactly this atomic
+                while b"\n" not in self._buf:
+                    chunk = self._sock.recv(65536)  # edl: noqa[EDL004] client request/reply transaction — the lock exists to make exactly this atomic
+                    if not chunk:
+                        raise CoordinatorError("coordinator closed connection")
+                    self._buf += chunk
+            except socket.timeout as e:
+                self.close()  # poison: the reply may arrive later on this socket
+                raise CoordinatorError(f"coordinator call {op!r} timed out") from e
+            except OSError as e:
+                self.close()
+                raise CoordinatorError(f"coordinator call {op!r} failed: {e}") from e
+            finally:
+                if self._sock is not None:
+                    self._sock.settimeout(None)
+            line, self._buf = self._buf.split(b"\n", 1)
         reply = json.loads(line)
         if isinstance(reply, dict) and reply.get("unauthorized"):
             raise CoordinatorAuthError(

@@ -44,6 +44,29 @@ def batch_sharding(mesh: Mesh, axis: BatchAxis = "data") -> NamedSharding:
     return replicate(mesh)
 
 
+def batch_shardings(
+    mesh: Mesh,
+    axis: BatchAxis = "data",
+    specs: Optional[Any] = None,
+):
+    """Sharding(s) for placing a host batch on ``mesh``.
+
+    With ``specs`` (a PartitionSpec pytree from `Model.batch_spec`): a
+    NamedSharding pytree matching the batch structure. Without: ONE
+    leading-dim batch sharding shared by every leaf. Hoisted out of the
+    placement tree_maps so NamedSharding construction happens once per
+    batch, not once per leaf — and reused by the AOT warm-compile path
+    (`Trainer.warm_compile`) to derive placed-batch avals without placing
+    anything.
+    """
+    if specs is not None:
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    return batch_sharding(mesh, axis)
+
+
 def shard_batch(
     batch: Any,
     mesh: Mesh,
@@ -66,19 +89,24 @@ def shard_batch(
     LOCAL slice and `jax.make_array_from_process_local_data` assembles the
     global array — no host ever holds the full batch.
     """
+    shardings = batch_shardings(mesh, axis, specs)
+    per_leaf = not isinstance(shardings, jax.sharding.Sharding)
     if jax.process_count() > 1:
-        def place(a, sharding):
-            return jax.make_array_from_process_local_data(sharding, np.asarray(a))
-
-        if specs is not None:
+        # Shardings are built once above and leaves convert to numpy in one
+        # pass here — mirroring the single-process batched dispatch below
+        # instead of rebuilding a NamedSharding and re-converting inside the
+        # assembly tree_map for every leaf of every step's batch.
+        host_batch = jax.tree_util.tree_map(lambda a: np.asarray(a), batch)
+        if per_leaf:
             return jax.tree_util.tree_map(
-                lambda a, s: place(a, NamedSharding(mesh, s)),
-                batch,
-                specs,
-                is_leaf=lambda x: isinstance(x, P),
+                lambda a, s: jax.make_array_from_process_local_data(s, a),
+                host_batch,
+                shardings,
             )
-        sharding = batch_sharding(mesh, axis)
-        return jax.tree_util.tree_map(lambda x: place(x, sharding), batch)
+        return jax.tree_util.tree_map(
+            lambda a: jax.make_array_from_process_local_data(shardings, a),
+            host_batch,
+        )
 
     # Single process: ONE device_put over the whole tree — a single batched
     # dispatch instead of one call per key. Device arrays pass through
@@ -89,13 +117,7 @@ def shard_batch(
     host_batch = jax.tree_util.tree_map(
         lambda a: a if isinstance(a, jax.Array) else np.asarray(a), batch
     )
-    if specs is not None:
-        shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
-        return jax.device_put(host_batch, shardings)
-    return jax.device_put(host_batch, batch_sharding(mesh, axis))
+    return jax.device_put(host_batch, shardings)
 
 
 def global_batch_size(local_batch: int, mesh: Mesh, axis: BatchAxis = "data") -> int:

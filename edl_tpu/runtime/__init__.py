@@ -27,13 +27,16 @@ from edl_tpu.runtime.export import (
     save_inference_model,
 )
 from edl_tpu.runtime.multihost import MultiHostWorker
+from edl_tpu.runtime.pipeline import DevicePrefetcher, PlacedItem
 from edl_tpu.runtime.wire import KVCodecChannel, WireCodec, WireRestartRequired
 
 __all__ = [
     "Checkpointer",
+    "DevicePrefetcher",
     "DistributedIdentity",
     "ElasticConfig",
     "ElasticWorker",
+    "PlacedItem",
     "FileShardSource",
     "InferenceModel",
     "KVCodecChannel",

@@ -27,6 +27,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Callable, Dict, Iterator, List, Mapping, Optional
@@ -211,47 +212,19 @@ def prefetch_iter(it: Iterator, depth: int = 2) -> Iterator:
     protocol routes differently). Exceptions — including SystemExit from
     a source that demands a gang restart — re-raise in the CONSUMER, not
     the pump thread, so control flow is identical to plain iteration.
+
+    Thin wrapper over :class:`edl_tpu.runtime.pipeline.DevicePrefetcher`
+    in raw read-ahead mode (no placement function): one pump
+    implementation serves both the read-ahead and the device-placement
+    pipelines.
     """
-    import queue as _queue
-    import threading as _threading
+    from edl_tpu.runtime.pipeline import DevicePrefetcher
 
-    q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
-    stop = _threading.Event()
-
-    def put(msg) -> bool:
-        # Timeout-put so an abandoned consumer (early break / exception in
-        # the training loop) cannot leave the pump parked in q.put forever,
-        # pinning the source iterator and buffered batches.
-        while not stop.is_set():
-            try:
-                q.put(msg, timeout=0.1)
-                return True
-            except _queue.Full:
-                continue
-        return False
-
-    def pump():
-        try:
-            for item in it:
-                if not put(("item", item)):
-                    return
-            put(("end", None))
-        except BaseException as e:  # edl: noqa[EDL005] relayed, not swallowed: the consumer re-raises it from the queue
-            put(("err", e))
-
-    t = _threading.Thread(target=pump, daemon=True, name="edl-batch-prefetch")
-    t.start()
-    try:
-        while True:
-            kind, val = q.get()
-            if kind == "item":
-                yield val
-            elif kind == "end":
-                return
-            else:
-                raise val
-    finally:
-        stop.set()
+    with DevicePrefetcher(
+        it, place_fn=None, depth=depth, thread_name="edl-batch-prefetch"
+    ) as pf:
+        for item in pf:
+            yield item.payload
 
 
 class LeaseReader:
@@ -295,8 +268,11 @@ class LeaseReader:
         self.prefetch = prefetch
         self.completed: List[str] = []
         #: defer mode: fully-read shards whose leases are still held, awaiting
-        #: a covering checkpoint.
-        self.consumed: List[str] = []
+        #: a covering checkpoint. A deque because under the pipelined loop
+        #: (`DevicePrefetcher`) ``_finish`` runs on the pump thread while
+        #: ``take_consumed`` drains on the consumer: append/popleft are
+        #: GIL-atomic, so the drain can never drop a shard.
+        self.consumed: "deque" = deque()
         #: the task whose batches are currently being yielded (per-pass
         #: metrics attribution; see ``split_pass``).
         self.current: Optional[str] = None
@@ -305,9 +281,16 @@ class LeaseReader:
 
     def take_consumed(self) -> List[str]:
         """Drain the consumed-but-uncompleted list (defer mode). The caller
-        completes these AFTER the checkpoint covering them is durable."""
-        out, self.consumed = self.consumed, []
-        return out
+        completes these AFTER the checkpoint covering them is durable.
+        Popleft-based so a concurrent ``_finish`` append (pump thread under
+        the pipelined loop) is either drained now or kept for next time —
+        never lost."""
+        out: List[str] = []
+        while True:
+            try:
+                out.append(self.consumed.popleft())
+            except IndexError:
+                return out
 
     def _finish(self, task: str) -> None:
         if self.defer_completion:

@@ -66,6 +66,17 @@ class ElasticConfig:
     #: while the current shard's batches feed training (costs one extra held
     #: lease + up to two shards of host RAM). See LeaseReader.
     prefetch: bool = False
+    #: device-side input pipelining: > 0 runs wire encode + H2D batch
+    #: placement on a pump thread (`runtime.pipeline.DevicePrefetcher`),
+    #: up to this many placed batches ahead of step dispatch. 0 places
+    #: synchronously. The lease RPCs move to the pump thread with the
+    #: reader; CoordinatorClient serializes per-request, so heartbeats and
+    #: checkpoint commits on the main thread interleave safely.
+    pipeline_depth: int = 2
+    #: AOT-compile the step for the new mesh on a background thread during
+    #: the rescale restore window, so the first post-rescale step dispatches
+    #: a ready executable instead of paying XLA inside the recovery budget.
+    warm_compile: bool = True
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
 
@@ -88,6 +99,11 @@ class RescaleEvent:
     from_world: int
     to_world: int
     recovery_seconds: float
+    #: new-mesh step compile time, overlapped with restore on a background
+    #: thread (0.0 when warm-compile was off or skipped) — reported as its
+    #: own field so the recovery interval it no longer sits inside stays
+    #: honest (bench_rescale.py).
+    compile_seconds: float = 0.0
 
 
 class ElasticWorker:
@@ -129,6 +145,10 @@ class ElasticWorker:
         self._carry_consumed: List[str] = []
         #: per-pass step counts (multi-pass training; key = pass index).
         self.pass_steps: Dict[int, int] = {}
+        #: host-batch avals (shape/dtype) observed at first placement —
+        #: what rescale warm-compile specializes the new mesh's step
+        #: against. Written once from whichever thread places first.
+        self._batch_avals: Optional[Dict[str, jax.ShapeDtypeStruct]] = None
 
     # -- membership ------------------------------------------------------------
 
@@ -192,8 +212,11 @@ class ElasticWorker:
         axes["data"] = n // fixed
         return build_mesh(MeshSpec(axes), devices)
 
-    def _restore_or_init(self, trainer: Trainer) -> TrainState:
-        fresh = trainer.init_state()
+    def _restore_or_init(
+        self, trainer: Trainer, fresh: Optional[TrainState] = None
+    ) -> TrainState:
+        if fresh is None:
+            fresh = trainer.init_state()
         if self.ckpt.latest_step() is None:
             return fresh
         state = self.ckpt.restore(
@@ -202,6 +225,79 @@ class ElasticWorker:
         log.info("restored checkpoint step=%s onto %d-device mesh",
                  self.ckpt.latest_step(), trainer.mesh.size)
         return state
+
+    def _note_batch_avals(self, batch: Dict) -> None:
+        if self._batch_avals is None:
+            self._batch_avals = {
+                k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for k, v in batch.items()
+            }
+
+    def _start_warm_compile(self, trainer: Trainer, fresh: TrainState):
+        """Kick off the new-mesh step compile on a daemon thread; returns
+        ``join() -> compile seconds`` (0.0 when disabled/skipped/failed).
+
+        Runs concurrently with ``ckpt.restore`` — the rescale drain already
+        made state durable, so by the time restore hands back resharded
+        state the executable is (ideally) ready and the first step on the
+        new mesh pays dispatch, not XLA. Needs the batch avals a previous
+        incarnation's first placement recorded; a cold start has none and
+        compiles lazily on step 1 exactly as before.
+        """
+        import threading
+
+        out = {"seconds": 0.0}
+        if not self.config.warm_compile or self._batch_avals is None:
+            return lambda: 0.0
+
+        def _compile():
+            try:
+                out["seconds"] = trainer.warm_compile(fresh, self._batch_avals)
+            except Exception:  # edl: noqa[EDL005] warm-compile is an optimization; a failure must degrade to the lazy step-1 compile, not kill the rescale
+                log.warning("rescale warm-compile failed; first step will "
+                            "compile lazily", exc_info=True)
+
+        t = threading.Thread(
+            target=_compile, daemon=True, name="edl-warm-compile"
+        )
+        t.start()
+
+        def join() -> float:
+            t.join()
+            return out["seconds"]
+
+        return join
+
+    def _dispatched(self, reader: LeaseReader, trainer: Trainer):
+        """Yield ``(placed, step_fn, task, samples, place_seconds)`` per
+        batch, placement pipelined per ``config.pipeline_depth``.
+
+        The pump closure snapshots ``reader.current`` at placement time so
+        per-pass step attribution follows the batch, not whatever shard the
+        reader has moved on to by step time; ``place_bound`` snapshots the
+        step callable for the same reason (codec widening in flight).
+        """
+        depth = self.config.pipeline_depth
+
+        def place(batch):
+            self._note_batch_avals(batch)
+            placed, step_fn = trainer.place_bound(batch)
+            return placed, step_fn, reader.current
+
+        if depth <= 0:
+            for batch in reader:
+                samples = len(next(iter(batch.values())))
+                t0 = time.perf_counter()
+                payload = place(batch)
+                yield (*payload, samples, time.perf_counter() - t0)
+            return
+        from edl_tpu.runtime.pipeline import DevicePrefetcher
+
+        with DevicePrefetcher(
+            reader, place, depth=depth, thread_name="edl-elastic-place-pump"
+        ) as pf:
+            for item in pf:
+                yield (*item.payload, item.samples, item.place_seconds)
 
     def _checkpoint(self, state: TrainState, block: bool = False) -> None:
         self.ckpt.save(int(state.step), state)
@@ -262,7 +358,14 @@ class ElasticWorker:
                 # The first step on a fresh mesh recompiles (20-40 s on TPU);
                 # keep it out of steady-state summaries.
                 self.profiler.mark_warmup()
-            state = self._restore_or_init(trainer)
+            # Warm-compile overlaps restore: fresh (abstract template for
+            # both) is built once, then the new mesh's step executable
+            # compiles on a background thread while orbax reshards the
+            # checkpoint onto the mesh.
+            fresh = trainer.init_state()
+            join_warm = self._start_warm_compile(trainer, fresh)
+            state = self._restore_or_init(trainer, fresh=fresh)
+            compile_seconds = join_warm()
             first_step_done = False
             last_ckpt_step = int(state.step)
             rescale = False
@@ -279,11 +382,11 @@ class ElasticWorker:
                 if self.profiler is not None:
                     self.profiler.start()
                 try:
-                    for batch in reader:
-                        placed = trainer.place_batch(batch)
-                        state, loss = trainer.train_step(state, placed)
+                    for placed, step_fn, task, samples, place_dt in \
+                            self._dispatched(reader, trainer):
+                        state, loss = step_fn(state, placed)
                         if self.profiler is not None:
-                            self.profiler.step(len(next(iter(batch.values()))))
+                            self.profiler.step(samples, place_seconds=place_dt)
                         if not first_step_done:
                             first_step_done = True
                             recovery = time.perf_counter() - rescale_t0
@@ -294,12 +397,13 @@ class ElasticWorker:
                                         from_world=self._prev_world,
                                         to_world=world,
                                         recovery_seconds=recovery,
+                                        compile_seconds=compile_seconds,
                                     )
                                 )
                         self.steps_done += 1
                         self.losses.append(float(loss))
-                        if reader.current is not None:
-                            p = split_pass(reader.current)[1]
+                        if task is not None:
+                            p = split_pass(task)[1]
                             self.pass_steps[p] = self.pass_steps.get(p, 0) + 1
                         step = int(state.step)
                         if self.config.step_callback is not None:

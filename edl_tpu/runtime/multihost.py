@@ -392,22 +392,23 @@ class MultiHostWorker:
             shard = tasks[rank % len(tasks)]  # tail rounds replicate remainder
             ran_steps = 0
 
-            def _train_one(batch) -> None:
+            def _train_one(placed, step_fn, samples, place_dt) -> None:
                 nonlocal state, ran_steps
-                placed = trainer.place_batch(batch)
-                state, loss = trainer.train_step(state, placed)
+                state, loss = step_fn(state, placed)
                 ran_steps += 1
                 self.steps_done += 1
                 self.losses.append(float(loss))
                 if self.profiler is not None:
-                    self.profiler.step(len(next(iter(batch.values()))))
+                    self.profiler.step(samples, place_seconds=place_dt)
                 if self.config.step_callback is not None:
                     self.config.step_callback(int(state.step), state)
 
             from edl_tpu.runtime.data import prefetch_iter
+            from edl_tpu.runtime.pipeline import DevicePrefetcher
             from edl_tpu.runtime.wire import WireRestartRequired
 
             steps = msg.get("steps")
+            depth = self.config.pipeline_depth
             try:
                 if steps is None:
                     # No batch_count metadata: shards must align by construction.
@@ -416,13 +417,33 @@ class MultiHostWorker:
                     # Run exactly `steps` collective steps; cycle a shorter
                     # shard's batches so every rank stays in lockstep.
                     batches = self._padded_batches(shard, tasks, steps)
-                if self.config.prefetch:
-                    # Batch-level read-ahead: shard decompression overlaps
-                    # the jitted step (exception-safe — a SystemExit from
-                    # the padded-batches fallback still reaches this thread).
-                    batches = prefetch_iter(batches)
-                for batch in batches:
-                    _train_one(batch)
+                if depth > 0:
+                    # Placement pump: wire encode + local-slice assembly of
+                    # batch N+1 overlap the collective step N. The pump pulls
+                    # from the source itself, so it subsumes `prefetch`'s
+                    # read-ahead; exceptions — including a SystemExit from
+                    # the padded-batches fallback — relay to this thread.
+                    with DevicePrefetcher(
+                        batches, trainer.place_bound, depth=depth,
+                        thread_name="edl-mh-place-pump",
+                    ) as pf:
+                        for item in pf:
+                            placed, step_fn = item.payload
+                            _train_one(placed, step_fn,
+                                       item.samples, item.place_seconds)
+                else:
+                    if self.config.prefetch:
+                        # Batch-level read-ahead: shard decompression overlaps
+                        # the jitted step (exception-safe — a SystemExit from
+                        # the padded-batches fallback still reaches this
+                        # thread).
+                        batches = prefetch_iter(batches)
+                    for batch in batches:
+                        samples = len(next(iter(batch.values())))
+                        t0 = time.perf_counter()
+                        placed, step_fn = trainer.place_bound(batch)
+                        _train_one(placed, step_fn, samples,
+                                   time.perf_counter() - t0)
             except WireRestartRequired as e:
                 # A batch overflowed the gang-negotiated wire codec; the
                 # widened floor is already published. Same recovery as a

@@ -1,0 +1,166 @@
+"""Device-side input pipeline: background batch placement.
+
+The steady-state hot loop historically did wire encode + ``shard_batch``
+H2D placement synchronously on the dispatch thread, so PCIe transfer and
+host-side codec work sat on the critical path between step dispatches —
+exactly the input-bound gap the elastic-trainer design is supposed to push
+off the accelerator. :class:`DevicePrefetcher` closes it: a depth-N pump
+thread runs the placement function (wire encode + ``shard_batch``) ahead of
+the consumer, so batch N+1's host codec work and H2D transfer overlap the
+device compute of step N.
+
+Contract (same as ``prefetch_iter`` in :mod:`edl_tpu.runtime.data`, which
+delegates here):
+
+- **Exception transparency** — anything the source iterator or the placement
+  function raises, including ``WireRestartRequired`` and a rescale
+  ``SystemExit``, re-raises in the CONSUMER, not the pump thread, so control
+  flow is identical to plain iteration.
+- **Clean drain** — a source that returns early (e.g. ``LeaseReader`` hitting
+  a rescale interrupt) ends the stream normally; batches already placed are
+  still delivered (they would have been trained in the synchronous loop
+  too), and the failed lease's replay covers them either way.
+- **No leaked pumps** — an abandoned consumer (early ``break``, exception in
+  the training loop) cannot park the pump forever: puts are timeout-polled
+  against a stop flag, and :meth:`close` (also run by the iterator's
+  ``finally`` and the context manager) joins the thread and drops buffered
+  batches.
+
+Retrace-canary cooperation: placement runs ahead of the consumer's
+``check_retrace`` call, and a wire-codec widening during placement rebuilds
+the wire jit *before* the consumer steps the batches already in flight. The
+placement function must therefore bind each batch to the program that steps
+it at placement time (``Trainer.place_bound``); the canary's cache-shrink
+baseline reset absorbs the rebuild itself.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, NamedTuple, Optional
+
+__all__ = ["DevicePrefetcher", "PlacedItem"]
+
+
+class PlacedItem(NamedTuple):
+    """One pumped batch: the placed payload plus its accounting."""
+
+    #: whatever ``place_fn`` returned (the batch itself when ``place_fn`` is
+    #: None — the raw read-ahead mode ``prefetch_iter`` uses).
+    payload: Any
+    #: host-side row count (0 when the batch shape is opaque).
+    samples: int
+    #: wall seconds the pump spent inside ``place_fn`` for this batch —
+    #: the work that overlapped device compute instead of preceding it.
+    place_seconds: float
+
+
+def _default_samples(batch: Any) -> int:
+    """Leading-dim row count of a mapping batch; 0 for opaque items."""
+    try:
+        first = next(iter(batch.values()))
+        return int(len(first))
+    except (AttributeError, TypeError, StopIteration):
+        return 0
+
+
+class DevicePrefetcher:
+    """Depth-N background placer: ``place_fn`` runs on a pump thread.
+
+    Iterating yields :class:`PlacedItem` in source order. The pump starts
+    eagerly at construction (the first placements begin while the consumer
+    is still compiling), stays at most ``depth`` placed batches ahead, and
+    relays exceptions — ``BaseException`` included, so rescale
+    ``SystemExit`` keeps its meaning — through the queue to the consumer.
+
+    No explicit lock: the bounded :class:`queue.Queue` is the only shared
+    state, and the stop flag is an :class:`threading.Event` — there is
+    nothing to hold across a blocking call.
+    """
+
+    def __init__(
+        self,
+        batches: Iterable[Any],
+        place_fn: Optional[Callable[[Any], Any]] = None,
+        depth: int = 2,
+        samples_of: Optional[Callable[[Any], int]] = None,
+        thread_name: str = "edl-place-pump",
+    ):
+        self._batches = iter(batches)
+        self._place = place_fn
+        self._samples_of = samples_of or _default_samples
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._pump_thread = threading.Thread(
+            target=self._pump, daemon=True, name=thread_name
+        )
+        self._pump_thread.start()
+
+    # -- pump side -------------------------------------------------------------
+
+    def _put(self, msg) -> bool:
+        # Timeout-put so an abandoned consumer cannot leave the pump parked
+        # in q.put forever, pinning the source iterator and placed buffers.
+        while not self._stop.is_set():
+            try:
+                self._q.put(msg, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _pump(self) -> None:
+        try:
+            for batch in self._batches:
+                if self._stop.is_set():
+                    return
+                samples = self._samples_of(batch)
+                t0 = time.perf_counter()
+                payload = batch if self._place is None else self._place(batch)
+                dt = time.perf_counter() - t0
+                if not self._put(("item", PlacedItem(payload, samples, dt))):
+                    return
+            self._put(("end", None))
+        except BaseException as e:  # edl: noqa[EDL005] relayed, not swallowed: the consumer re-raises it from the queue
+            self._put(("err", e))
+
+    # -- consumer side ---------------------------------------------------------
+
+    def __iter__(self) -> Iterator[PlacedItem]:
+        try:
+            while True:
+                try:
+                    kind, val = self._q.get(timeout=0.5)
+                except queue.Empty:
+                    if self._stop.is_set() or not self._pump_thread.is_alive():
+                        return  # closed, or pump died post-close: stream over
+                    continue
+                if kind == "item":
+                    yield val
+                elif kind == "end":
+                    return
+                else:
+                    raise val
+        finally:
+            self.close()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the pump, join it, and drop buffered batches. Idempotent;
+        safe from any thread (including the iterator's own ``finally``)."""
+        self._stop.set()
+        t = self._pump_thread
+        if t is not threading.current_thread() and t.is_alive():
+            t.join(timeout)
+        while True:  # free placed device buffers an abandoned consumer left
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -25,9 +25,31 @@ import optax
 from jax.sharding import Mesh
 
 from edl_tpu.models.base import Model
-from edl_tpu.parallel.sharding import shard_batch
+from edl_tpu.parallel.sharding import batch_shardings, shard_batch
 
 log = logging.getLogger("edl_tpu.trainer")
+
+
+def _aval_signature(tree: Any) -> Tuple:
+    """Hashable (structure, per-leaf shape/dtype/sharding) key for a pytree
+    of arrays or ShapeDtypeStructs — what an AOT-compiled executable is
+    specialized to. Leaves without a sharding (host numpy) key as None."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (
+        treedef,
+        tuple(
+            (tuple(x.shape), str(np.dtype(x.dtype)), getattr(x, "sharding", None))
+            for x in leaves
+        ),
+    )
+
+
+class _WarmStep(NamedTuple):
+    """An AOT-compiled step executable and the avals it is specialized to."""
+
+    fn: Any  # jax.stages.Compiled
+    batch_signature: Tuple
+    seconds: float  # compile wall time (reported by the rescale bench)
 
 
 class TrainState(NamedTuple):
@@ -60,6 +82,12 @@ class TrainerConfig:
     #: layouts are untouched, so the math is identical. Already-sharded
     #: moments (e.g. row-sharded embedding tables') keep their sharding.
     shard_opt_state: bool = False
+    #: device-side input pipelining for ``Trainer.run``: 0 places each batch
+    #: synchronously on the dispatch thread; N >= 1 runs ``place_batch``
+    #: (wire encode + H2D shard placement) on a background pump thread,
+    #: staying up to N placed batches ahead of step dispatch
+    #: (`edl_tpu.runtime.pipeline.DevicePrefetcher`).
+    pipeline_depth: int = 0
 
 
 def _make_optimizer(cfg: TrainerConfig) -> optax.GradientTransformation:
@@ -137,6 +165,12 @@ class Trainer:
         self.retraces = 0
         self._compiles_seen: Optional[int] = None
         self._warmed = False  # set once the jit cache holds steady one step
+        #: memoized "this JAX version has no private _cache_size API" — set
+        #: after the first None so the per-step canary probe stops
+        #: re-reflecting over both jits for the rest of the run.
+        self._cache_probe_broken = False
+        #: AOT warm-compiled step executable (rescale warm-compile path).
+        self._warm: Optional[_WarmStep] = None
 
     # -- state -----------------------------------------------------------------
 
@@ -276,26 +310,151 @@ class Trainer:
         )
         return shard_batch(batch, self.mesh, self.config.batch_axis, specs=specs)
 
-    def train_step(self, state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, jax.Array]:
+    def _step_callable(self, batch: Dict[str, Any]) -> Callable:
+        """The program that will step ``batch``: the wire-decode jit for
+        encoded batches, the AOT warm-compiled executable when one matches
+        the batch avals, else the plain jit."""
         if self._codec is not None and self._codec.is_encoded(batch):
-            return self._jit_step_wire(state, batch)
-        return self._jit_step(state, batch)
+            return self._jit_step_wire
+        if (
+            self._warm is not None
+            and _aval_signature(batch) == self._warm.batch_signature
+        ):
+            return self._warm_step
+        return self._jit_step
+
+    def _warm_step(self, state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, jax.Array]:
+        """Dispatch to the AOT warm-compiled executable; retire it and fall
+        back to the jit on any aval/sharding mismatch it rejects (the batch
+        signature can't see everything — e.g. state layout drift)."""
+        warm = self._warm
+        try:
+            return warm.fn(state, batch)
+        except (TypeError, ValueError) as e:
+            log.warning(
+                "warm-compiled step rejected its inputs (%s); retiring it "
+                "and falling back to jit", e,
+            )
+            self._warm = None
+            return self._jit_step(state, batch)
+
+    def place_bound(self, batch: Dict[str, np.ndarray]) -> Tuple[Dict[str, Any], Callable]:
+        """Place a batch AND snapshot the program that must step it.
+
+        The pipelined hot loop (`DevicePrefetcher`) runs placement ahead of
+        stepping, and a wire-codec widening during placement rebuilds
+        ``_jit_step_wire`` — binding at placement time keeps each in-flight
+        batch paired with the codec generation that encoded it.
+        """
+        placed = self.place_batch(batch)
+        return placed, self._step_callable(placed)
+
+    def train_step(self, state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, jax.Array]:
+        return self._step_callable(batch)(state, batch)
+
+    # -- rescale warm-compile --------------------------------------------------
+
+    def warm_compile(
+        self,
+        state: TrainState,
+        host_batch_avals: Dict[str, jax.ShapeDtypeStruct],
+    ) -> float:
+        """AOT-compile the step for this mesh from abstract inputs; returns
+        compile wall seconds (0.0 when skipped).
+
+        Run on a background thread during the rescale checkpoint/drain
+        window (`runtime/elastic.py`) so restore lands on a ready
+        executable and the first post-rescale step pays dispatch, not XLA.
+        ``host_batch_avals`` describes the HOST batch (shape/dtype only);
+        placed-batch shardings are derived exactly like ``place_batch``
+        derives them, so the executable matches what the hot loop feeds it.
+
+        Wire transport is warm-compiled only once this trainer holds a
+        negotiated codec; before first placement there is nothing to
+        specialize against (guessing an encoding would compile a program
+        the hot loop never runs), so we skip and report 0.0 — the elastic
+        rescale path, which builds a FRESH trainer per mesh, therefore
+        warm-compiles the raw-transport step only.
+        """
+        t0 = time.perf_counter()
+        if self.config.wire_transport and self._codec is None:
+            log.debug("warm_compile skipped: wire codec not negotiated yet")
+            return 0.0
+        specs = (
+            self.model.batch_spec(self.mesh)
+            if self.model.batch_spec is not None
+            else None
+        )
+        if self.config.wire_transport:
+            # Encoded-batch avals via a zeros round-trip: zeros fit every
+            # int encoding's range, so this cannot overflow-widen the codec.
+            zeros = {
+                k: np.zeros(v.shape, v.dtype) for k, v in host_batch_avals.items()
+            }
+            host_batch_avals = {
+                k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for k, v in self._codec.encode(zeros).items()
+            }
+        shardings = batch_shardings(self.mesh, self.config.batch_axis, specs)
+        if isinstance(shardings, jax.sharding.Sharding):
+            abstract_batch = {
+                k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=shardings)
+                for k, v in host_batch_avals.items()
+            }
+        else:
+            abstract_batch = jax.tree_util.tree_map(
+                lambda v, s: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=s),
+                dict(host_batch_avals),
+                shardings,
+            )
+        def state_aval(x):
+            # Only committed arrays pin their sharding into the lowering.
+            # Uncommitted leaves (e.g. the step counter, fresh optimizer
+            # counts) sit on a single device and would otherwise conflict
+            # with the mesh-placed params; leaving their sharding
+            # unspecified lets jit place them exactly as the lazy path does.
+            sharding = (
+                x.sharding if getattr(x, "_committed", False) else None
+            )
+            return jax.ShapeDtypeStruct(jnp.shape(x), x.dtype, sharding=sharding)
+
+        abstract_state = jax.tree_util.tree_map(state_aval, state)
+        target = (
+            self._jit_step_wire if self.config.wire_transport else self._jit_step
+        )
+        compiled = target.lower(abstract_state, abstract_batch).compile()
+        seconds = time.perf_counter() - t0
+        # AOT lower().compile() does NOT populate the jit dispatch cache
+        # (verified: _cache_size stays 0 and the first normal call
+        # recompiles), so the executable is kept and dispatched directly
+        # via _step_callable's signature match.
+        self._warm = _WarmStep(compiled, _aval_signature(abstract_batch), seconds)
+        log.info(
+            "warm-compiled step for mesh %s in %.2fs", dict(self.mesh.shape), seconds
+        )
+        return seconds
 
     # -- retracing canary ------------------------------------------------------
 
     def _jit_cache_size(self) -> Optional[int]:
         """Total compiled-program count across the step jits (None when the
-        private ``_cache_size`` API is unavailable on this JAX version)."""
+        private ``_cache_size`` API is unavailable on this JAX version).
+        Unavailability is memoized after the first None so the per-step
+        canary probe stops re-reflecting over both jits for the whole run."""
+        if self._cache_probe_broken:
+            return None
         total = 0
         for fn in (self._jit_step, self._jit_step_wire):
             if fn is None:
                 continue
             cache_size = getattr(fn, "_cache_size", None)
             if cache_size is None:
+                self._cache_probe_broken = True
                 return None
             try:
                 total += int(cache_size())
             except Exception:  # edl: noqa[EDL005] observability probe on a private API; a broken probe must not fail the step
+                self._cache_probe_broken = True
                 return None
         return total
 
@@ -334,6 +493,31 @@ class Trainer:
             return True
         return False
 
+    def _dispatch_iter(
+        self, batches: Iterator[Dict[str, np.ndarray]], depth: int
+    ) -> Iterator[Tuple[Dict[str, Any], Callable, int, float]]:
+        """Yield ``(placed, step_fn, samples, place_seconds)`` per batch.
+
+        depth == 0: place synchronously on the dispatch thread (timed
+        inline). depth >= 1: run ``place_bound`` on a DevicePrefetcher pump
+        thread so encode + H2D placement of batch N+1 overlaps step N; the
+        step callable is snapshotted at placement time (codec widening
+        in-flight must not re-route already-encoded batches).
+        """
+        if depth <= 0:
+            for batch in batches:
+                first = next(iter(batch.values()))
+                t0 = time.perf_counter()
+                placed, step_fn = self.place_bound(batch)
+                yield placed, step_fn, len(first), time.perf_counter() - t0
+            return
+        from edl_tpu.runtime.pipeline import DevicePrefetcher
+
+        with DevicePrefetcher(batches, self.place_bound, depth=depth) as pf:
+            for item in pf:
+                placed, step_fn = item.payload
+                yield placed, step_fn, item.samples, item.place_seconds
+
     def run(
         self,
         state: TrainState,
@@ -341,8 +525,15 @@ class Trainer:
         max_steps: Optional[int] = None,
         on_step: Optional[Callable[[int, float], None]] = None,
         profiler: Optional[Any] = None,
+        pipeline_depth: Optional[int] = None,
     ) -> Tuple[TrainState, Dict[str, float]]:
         """Drive the hot loop host-side: place batch, step, account throughput.
+
+        ``pipeline_depth`` (default ``config.pipeline_depth``) > 0 moves
+        placement onto a background pump thread (`DevicePrefetcher`) so
+        wire encode + H2D transfer overlap device compute; exceptions from
+        the batch source or placement re-raise here exactly as in the
+        synchronous loop.
 
         Losses stay on-device until the loop ends so JAX async dispatch can
         pipeline steps; passing ``on_step`` forces a per-step sync (use it for
@@ -353,10 +544,14 @@ class Trainer:
         (in-flight tail steps are not awaited); the returned ``metrics``
         dict's ``samples_per_sec`` is computed after the final sync.
         """
+        depth = (
+            self.config.pipeline_depth if pipeline_depth is None else pipeline_depth
+        )
         losses = []
         n = 0
         t0 = time.perf_counter()
         samples = 0
+        place_seconds = 0.0
         if profiler is not None:
             # Let the profiler's summary account FLOPs/MFU without the
             # caller having to thread the model/mesh through twice.
@@ -365,17 +560,18 @@ class Trainer:
             if getattr(profiler, "n_chips", -1) is None:
                 profiler.n_chips = max(1, self.mesh.devices.size)
             profiler.start()
-        for batch in batches:
-            placed = self.place_batch(batch)
-            first = next(iter(batch.values()))
-            samples += len(first)
-            state, loss = self.train_step(state, placed)
+        for placed, step_fn, batch_samples, place_dt in self._dispatch_iter(
+            batches, depth
+        ):
+            samples += batch_samples
+            place_seconds += place_dt
+            state, loss = step_fn(state, placed)
             n += 1
             self.check_retrace(n)
             if on_step is not None:
                 on_step(n, float(loss))
             if profiler is not None:
-                profiler.step(len(first))
+                profiler.step(batch_samples, place_seconds=place_dt)
             losses.append(loss)
             if max_steps is not None and n >= max_steps:
                 break
@@ -388,5 +584,6 @@ class Trainer:
             "samples_per_sec": samples / elapsed,
             "seconds": elapsed,
             "retraces": float(self.retraces),
+            "place_seconds": place_seconds,
         }
         return state, metrics

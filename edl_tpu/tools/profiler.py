@@ -50,6 +50,11 @@ class StepRecord:
     loss: Optional[float] = None
     #: excluded from steady-state summaries (jit compile / post-rescale).
     warmup: bool = False
+    #: host-side batch placement time (wire encode + H2D shard placement)
+    #: attributed to this step. In the synchronous loop it is part of
+    #: ``seconds``; in the pipelined loop it ran on the pump thread and
+    #: overlapped an earlier step's device compute.
+    place_seconds: Optional[float] = None
 
     def to_dict(self) -> dict:
         d = {"step": self.step, "seconds": round(self.seconds, 6), "samples": self.samples}
@@ -57,6 +62,8 @@ class StepRecord:
             d["loss"] = self.loss
         if self.warmup:
             d["warmup"] = True
+        if self.place_seconds is not None:
+            d["place_ms"] = round(self.place_seconds * 1e3, 3)
         return d
 
 
@@ -110,15 +117,22 @@ class StepProfiler:
         will recompile (mesh rebuild after an elastic rescale)."""
         self._pending_warmup += n
 
-    def step(self, samples: int, loss: Optional[float] = None) -> StepRecord:
-        """Record one completed step of ``samples`` examples."""
+    def step(self, samples: int, loss: Optional[float] = None,
+             place_seconds: Optional[float] = None) -> StepRecord:
+        """Record one completed step of ``samples`` examples.
+
+        ``place_seconds`` — this batch's host placement time, recorded as
+        its own series so the place/step split survives into jsonl sinks
+        and summaries (the pipelined loop's placement happens off the
+        dispatch thread, invisible to ``seconds``)."""
         now = time.perf_counter()
         start = self._mark if self._mark is not None else now
         is_warmup = self._count < self.warmup or self._pending_warmup > 0
         if self._pending_warmup > 0:
             self._pending_warmup -= 1
         rec = StepRecord(step=self._count, seconds=now - start,
-                         samples=samples, loss=loss, warmup=is_warmup)
+                         samples=samples, loss=loss, warmup=is_warmup,
+                         place_seconds=place_seconds)
         self._count += 1
         self._mark = now
         self.records.append(rec)
@@ -159,6 +173,11 @@ class StepProfiler:
             "step_time_p95_s": _percentile(times, 0.95),
             "step_time_max_s": times[-1],
         }
+        places = sorted(r.place_seconds for r in steady
+                        if r.place_seconds is not None)
+        if places:
+            out["place_time_mean_s"] = sum(places) / len(places)
+            out["place_time_p50_s"] = _percentile(places, 0.5)
         if getattr(self.model, "flops_per_step", None) is not None \
                 and total > 0 and samples:
             from edl_tpu.tools.mfu import mfu_fields

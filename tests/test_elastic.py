@@ -159,6 +159,8 @@ def test_elastic_worker_rescales_4_to_8(tmp_path):
     assert worker.rescales[0].from_world == 1
     assert worker.rescales[0].to_world == 2
     assert metrics["max_recovery_seconds"] < 30.0, metrics
+    # the new-mesh executable was AOT-compiled during the drain window
+    assert worker.rescales[0].compile_seconds > 0.0, worker.rescales
     # all shards completed exactly once overall (replays allowed, but the
     # queue drains and nothing is lost)
     st = admin.status()
