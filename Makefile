@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: analyze analyze-json baseline test lint
+.PHONY: analyze analyze-json baseline test chaos lint
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py
@@ -18,5 +18,10 @@ baseline:
 
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow'
+
+## Fault-injection suite: every chaos-marked test, INCLUDING the slow
+## process-kill soaks tier-1 skips.
+chaos:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
 
 lint: analyze

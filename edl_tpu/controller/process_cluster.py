@@ -96,7 +96,7 @@ class ProcessCluster:
             if role == "trainer":
                 self._parallelism[job_name] = replicas
             self._templates.setdefault(job_name, {})[role] = (
-                requests, limits, workload
+                replicas, requests, limits, workload
             )
             for _ in range(replicas):
                 self._spawn(job_name, role, requests, limits, workload)
@@ -228,7 +228,7 @@ class ProcessCluster:
             template = self._templates.get(job_name, {}).get("trainer")
             if template is None:
                 return
-            requests, limits, workload = template
+            _, requests, limits, workload = template
             for _ in range(want - len(live)):
                 self._spawn(job_name, "trainer", requests, limits, workload)
 
@@ -268,22 +268,37 @@ class ProcessCluster:
                     return
         raise KeyError(f"no live pod {pod_name}")
 
-    def restart_failed(self, job_name: str) -> int:
-        """The K8s Job controller's reconcile for crashed pods: replace
-        Failed trainer pods with fresh ones up to the job's parallelism
-        (new pod name — the replacement registers as a new worker and the
-        dead one's membership/leases expire by TTL). Returns pods spawned."""
+    def restart_failed(self, job_name: str, role: str = "trainer") -> int:
+        """The K8s controller's reconcile for crashed pods: replace Failed
+        pods of ``role`` with fresh ones up to the role's target count —
+        the job's parallelism for trainers, the created replica count for
+        every other role. A replaced trainer registers as a new worker and
+        the dead one's membership/leases expire by TTL; a replaced
+        COORDINATOR pod re-runs its workload with the same EDL_* env —
+        same port, state_file, and run_id — so it resumes its journal
+        (the master-ReplicaSet recovery, `pkg/controller.go:119-134`).
+        Returns pods spawned."""
         with self._lock:
             self._reap()
-            if (job_name not in self._parallelism
-                    or self._templates.get(job_name, {}).get("trainer") is None):
+            template = self._templates.get(job_name, {}).get(role)
+            if template is None:
+                return 0
+            if role == "trainer" and job_name not in self._parallelism:
                 return 0
             failed = [p for p in self.pods
                       if p.info.job_name == job_name
-                      and p.info.role == "trainer"
+                      and p.info.role == role
                       and p.info.phase == "Failed"]
             for pod in failed:  # terminal records: GC like a Job controller
                 self.pods.remove(pod)
             before = len(self.pods)
-            self._reconcile(job_name)  # the spawn-up half lives there
+            if role == "trainer":
+                self._reconcile(job_name)  # the spawn-up half lives there
+            else:
+                replicas, requests, limits, workload = template
+                live = [p for p in self.pods
+                        if p.info.job_name == job_name and p.info.role == role
+                        and p.info.phase in ("Pending", "Running")]
+                for _ in range(max(0, replicas - len(live))):
+                    self._spawn(job_name, role, requests, limits, workload)
             return len(self.pods) - before

@@ -11,18 +11,32 @@ etcd sidecar + pserver self-registration (SURVEY §2.2). Provides:
 - ``InProcessCoordinator`` — pure-Python twin of the C++ state machine for
   hermetic unit tests (the role the fake clientset plays in the reference,
   `pkg/client/.../fake`).
+- ``RetryPolicy`` / ``OutboxClient`` — outage resilience: typed retries in
+  the client, buffered side effects + degraded-mode reads in the worker
+  (doc/robustness.md has the failure model).
+- ``CoordinatorSupervisor`` — keeps a native coordinator process alive,
+  restarting it with the same state_file + run_id.
 """
 
 from edl_tpu.coordinator.client import (
     CoordinatorAuthError, CoordinatorClient, CoordinatorError,
+    CoordinatorTimeout, CoordinatorUnreachable,
 )
 from edl_tpu.coordinator.inprocess import InProcessCoordinator
-from edl_tpu.coordinator.server import CoordinatorServer
+from edl_tpu.coordinator.outbox import Outbox, OutboxClient
+from edl_tpu.coordinator.retry import RetryPolicy
+from edl_tpu.coordinator.server import CoordinatorServer, CoordinatorSupervisor
 
 __all__ = [
     "CoordinatorClient",
     "CoordinatorAuthError",
     "CoordinatorError",
+    "CoordinatorTimeout",
+    "CoordinatorUnreachable",
     "CoordinatorServer",
+    "CoordinatorSupervisor",
     "InProcessCoordinator",
+    "Outbox",
+    "OutboxClient",
+    "RetryPolicy",
 ]

@@ -13,7 +13,10 @@ import os
 import socket
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional
+
+from edl_tpu.coordinator.retry import DEFAULT_RETRY, RetryPolicy
 
 
 class CoordinatorError(RuntimeError):
@@ -26,6 +29,26 @@ class CoordinatorAuthError(CoordinatorError):
     Typed separately because the right reaction differs from transport
     errors: retrying cannot help — the pod's EDL_COORD_TOKEN disagrees
     with the job's, which is a deployment bug (or an unauthorized peer).
+    """
+
+
+class CoordinatorTimeout(CoordinatorError):
+    """The reply did not arrive within the caller's timeout.
+
+    Not retried by the client: the request may have been processed (a
+    barrier arrival whose release is still pending, a lease grant with a
+    slow reply), so a blind re-send is not safe at this layer. Callers
+    with idempotent semantics re-issue at their own layer.
+    """
+
+
+class CoordinatorUnreachable(CoordinatorError):
+    """Connection-level failure: refused, reset, or closed mid-call.
+
+    The retryable class — ``call()`` re-dials with backoff until the
+    retry policy's deadline, and raises this only once that budget is
+    spent. Degraded-mode callers (outbox buffering, checkpoint-and-park)
+    key off this type.
     """
 
 
@@ -43,18 +66,35 @@ class CoordinatorClient:
     ``token`` is the per-job shared secret (default: the pod env's
     EDL_COORD_TOKEN, stamped by the controller — jobparser.make_env); it
     rides every request. Auth-rejected calls raise CoordinatorAuthError.
+
+    ``retry`` is the outage policy baked into every ``call()``: connection
+    failures re-dial with jittered exponential backoff until the policy
+    deadline, then raise CoordinatorUnreachable. Pass ``retry=None`` for
+    the legacy crash-on-first-error behavior (some tests want it). Auth
+    errors and reply timeouts are never retried — see retry.py's taxonomy.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7164,
                  worker: str = "", connect_timeout: float = 10.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = DEFAULT_RETRY):
         self.host = host
         self.port = port
         self.worker = worker
         self.token = token if token is not None \
             else os.environ.get("EDL_COORD_TOKEN", "")
+        self.retry = retry
+        #: transport-level retry attempts performed over this client's
+        #: lifetime (outage telemetry; workers surface it in summaries).
+        self.retry_count = 0
         self._sock: Optional[socket.socket] = None
         self._buf = b""
+        #: per-client nonce namespaces dedup ids (req_id/op_id) so a fresh
+        #: process reusing a worker name can never hit a predecessor's
+        #: cached replies or persisted kv_incr markers.
+        self._nonce = uuid.uuid4().hex[:8]
+        self._acquire_seq = 0
+        self._op_seq = 0
         #: serializes one full request/reply transaction per call() — the
         #: socket and _buf pair replies to requests by ordering, so
         #: interleaved sends from two threads would cross-deliver replies.
@@ -65,6 +105,7 @@ class CoordinatorClient:
     def _connect(self, timeout: float) -> None:
         deadline = time.monotonic() + timeout
         last_err: Optional[Exception] = None
+        sleeps = (self.retry or DEFAULT_RETRY).sleeps()
         while time.monotonic() < deadline:
             try:
                 sock = socket.create_connection((self.host, self.port), timeout=5.0)
@@ -74,8 +115,8 @@ class CoordinatorClient:
                 return
             except OSError as e:
                 last_err = e
-                time.sleep(0.1)
-        raise CoordinatorError(
+                time.sleep(min(next(sleeps), max(0.0, deadline - time.monotonic())))
+        raise CoordinatorUnreachable(
             f"cannot connect to coordinator at {self.host}:{self.port}: {last_err}"
         )
 
@@ -96,6 +137,32 @@ class CoordinatorClient:
     # -- protocol --------------------------------------------------------------
 
     def call(self, op: str, timeout: Optional[float] = None, **fields) -> Dict:
+        """One request/reply transaction, with the retry policy applied.
+
+        Retries cover only ``CoordinatorUnreachable`` (refused / reset /
+        closed): the request was not answered, so re-sending is safe for
+        every op — mutating ops carry dedup ids (``req_id``/``op_id``) or
+        are idempotent server-side (``complete_task``). Auth rejections
+        and reply timeouts propagate immediately.
+        """
+        if self.retry is None:
+            return self._call_once(op, timeout, fields)
+        deadline = time.monotonic() + self.retry.deadline
+        sleeps = self.retry.sleeps()
+        while True:
+            try:
+                return self._call_once(op, timeout, fields)
+            except (CoordinatorAuthError, CoordinatorTimeout):
+                raise
+            except CoordinatorUnreachable:
+                delay = next(sleeps)
+                if time.monotonic() + delay >= deadline:
+                    raise
+                self.retry_count += 1  # edl: noqa[EDL001] telemetry counter; a torn increment under-counts a metric, never corrupts protocol state
+                time.sleep(delay)
+
+    def _call_once(self, op: str, timeout: Optional[float],
+                   fields: Dict) -> Dict:
         # The lock intentionally spans the socket round-trip: this is a
         # CLIENT connection whose replies pair to requests by ordering, so
         # the transaction must be atomic per thread — unlike the
@@ -120,14 +187,18 @@ class CoordinatorClient:
                 while b"\n" not in self._buf:
                     chunk = self._sock.recv(65536)  # edl: noqa[EDL004] client request/reply transaction — the lock exists to make exactly this atomic
                     if not chunk:
-                        raise CoordinatorError("coordinator closed connection")
+                        # EOF: close now so a retry re-dials instead of
+                        # re-sending into the half-closed socket.
+                        self.close()
+                        raise CoordinatorUnreachable("coordinator closed connection")
                     self._buf += chunk
             except socket.timeout as e:
                 self.close()  # poison: the reply may arrive later on this socket
-                raise CoordinatorError(f"coordinator call {op!r} timed out") from e
+                raise CoordinatorTimeout(f"coordinator call {op!r} timed out") from e
             except OSError as e:
                 self.close()
-                raise CoordinatorError(f"coordinator call {op!r} failed: {e}") from e
+                raise CoordinatorUnreachable(
+                    f"coordinator call {op!r} failed: {e}") from e
             finally:
                 if self._sock is not None:
                     self._sock.settimeout(None)
@@ -173,11 +244,21 @@ class CoordinatorClient:
         return int(self.call("add_tasks", tasks=list(tasks))["added"])
 
     def acquire_task(self) -> Optional[str]:
-        return self.call("acquire_task").get("task")
+        return self.acquire().get("task")
 
     def acquire(self) -> Dict:
-        """Full acquire reply: {task: str|None, exhausted: bool when drained}."""
-        return self.call("acquire_task")
+        """Full acquire reply: {task: str|None, exhausted: bool when drained}.
+
+        Each acquire carries a per-connection ``req_id`` so a retry after a
+        lost reply returns the *same* lease instead of popping a second
+        task (which would pin a zombie lease renewed by every heartbeat).
+        The server answers a repeated (worker, req_id) from its dedup
+        cache while the cached task is still leased to this worker.
+        """
+        with self._lock:
+            self._acquire_seq += 1
+            req_id = f"{self._nonce}.{self._acquire_seq}"
+        return self.call("acquire_task", req_id=req_id)
 
     def complete_task(self, task: str) -> Dict:
         return self.call("complete_task", task=task)
@@ -193,28 +274,37 @@ class CoordinatorClient:
         Replaces the launcher's sleep-and-poll barriers
         (docker/paddle_k8s:128-130,178) with a real rendezvous. On timeout
         returns {"ok": False, "error": "timeout"} (matching the in-process
-        twin) rather than raising; the connection is re-established.
+        twin) rather than raising; the connection is re-established. A
+        transport failure is *not* a timeout — it returns {"ok": False,
+        "error": "unreachable"} so callers retry the rendezvous instead of
+        proceeding as if peers were merely late on a dead coordinator.
         """
         try:
             return self.call("barrier", timeout=timeout, name=name, count=count)
         except CoordinatorAuthError:
             raise  # deployment bug, not a timeout — never mask it
-        except CoordinatorError:
+        except CoordinatorTimeout:
             return {"ok": False, "error": "timeout"}
+        except CoordinatorError:
+            return {"ok": False, "error": "unreachable"}
 
     def sync(self, epoch: int, timeout: float = 60.0) -> Dict:
         """Epoch-synchronized rendezvous (the rescale sync point): blocks
         until every current member arrives at ``epoch``. Replies:
         {"ok": True} released; {"ok": False, "resync": True, epoch, world}
         when membership moved (retry with the new epoch); {"ok": False,
-        "error": "timeout"} on client-side timeout.
+        "error": "timeout"} on client-side timeout; {"ok": False,
+        "error": "unreachable"} when the coordinator cannot be reached —
+        distinct so rendezvous loops re-enter instead of giving up.
         """
         try:
             return self.call("sync", timeout=timeout, epoch=int(epoch))
         except CoordinatorAuthError:
             raise  # deployment bug, not a timeout — never mask it
-        except CoordinatorError:
+        except CoordinatorTimeout:
             return {"ok": False, "error": "timeout"}
+        except CoordinatorError:
+            return {"ok": False, "error": "unreachable"}
 
     # -- KV (etcd-role subset) -------------------------------------------------
 
@@ -228,8 +318,18 @@ class CoordinatorClient:
         self.call("kv_del", key=key)
 
     def kv_incr(self, key: str, delta: int = 1) -> int:
-        """Server-side atomic add; returns the new value."""
-        reply = self.call("kv_incr", key=key, delta=int(delta))
+        """Server-side atomic add; returns the new value.
+
+        Carries an ``op_id`` so a retried increment (lost reply, or a
+        replay across a coordinator restart) applies exactly once: the
+        server persists applied op_ids alongside the KV namespace and
+        answers duplicates with the value recorded at first application.
+        Failure budgets counted this way cannot double-count an outage.
+        """
+        with self._lock:
+            self._op_seq += 1
+            op_id = f"{self._nonce}.{self._op_seq}"
+        reply = self.call("kv_incr", key=key, delta=int(delta), op_id=op_id)
         if not reply.get("ok"):
             raise CoordinatorError(f"kv_incr failed: {reply.get('error')}")
         return int(reply["value"])

@@ -34,6 +34,9 @@ class InProcessCoordinator:
         self._members: Dict[str, Dict] = {}  # name -> {rank, last_heartbeat}
         self._todo: deque = deque()
         self._leased: Dict[str, Dict] = {}  # task -> {worker, deadline}
+        # Last acquire per worker: worker -> (req_id, task), so a retried
+        # acquire (lost reply) gets the same lease back (native parity).
+        self._acquire_cache: Dict[str, tuple] = {}
         self._done: Set[str] = set()
         self._barriers: Dict[str, Dict] = {}  # name -> {arrived, generation}
         self._sync_arrived: Set[str] = set()
@@ -65,6 +68,7 @@ class InProcessCoordinator:
         self._next_rank = len(self._members)
         self._epoch += 1
         self._requeue_worker_leases(name)
+        self._acquire_cache.pop(name, None)
         self._release_sync()
 
     def _release_sync(self) -> None:
@@ -164,9 +168,20 @@ class InProcessCoordinator:
                 added += 1
             return added
 
-    def acquire(self, worker: str) -> Dict:
+    def acquire(self, worker: str, req_id: Optional[str] = None) -> Dict:
         with self._lock:
             self._tick()
+            # Dedup (native parity): a retried acquire with the same req_id
+            # returns the existing lease instead of popping a second task.
+            if req_id:
+                cached = self._acquire_cache.get(worker)
+                if cached and cached[0] == req_id:
+                    lease = self._leased.get(cached[1])
+                    if lease and lease["worker"] == worker:
+                        lease["deadline"] = time.monotonic() + self.task_lease_sec
+                        return {"ok": True, "task": cached[1],
+                                "lease_sec": self.task_lease_sec,
+                                "duplicate": True}
             if not self._todo:
                 return {"ok": True, "task": None, "exhausted": not self._leased}
             task = self._todo.popleft()
@@ -174,6 +189,8 @@ class InProcessCoordinator:
                 "worker": worker,
                 "deadline": time.monotonic() + self.task_lease_sec,
             }
+            if req_id:
+                self._acquire_cache[worker] = (req_id, task)
             return {"ok": True, "task": task, "lease_sec": self.task_lease_sec}
 
     def acquire_task(self, worker: str) -> Optional[str]:
@@ -182,7 +199,19 @@ class InProcessCoordinator:
     def complete_task(self, worker: str, task: str) -> Dict:
         with self._lock:
             self._tick()
+            # Idempotent (native parity): replayed completions are success.
+            if task in self._done:
+                return {"ok": True, "duplicate": True,
+                        "done": len(self._done), "queued": len(self._todo)}
             if task not in self._leased:
+                # Requeued-but-unleased after an outage: the completer holds
+                # a durable covering checkpoint, so accept rather than
+                # retrain. Unknown tasks stay an error.
+                if task in self._todo:
+                    self._todo.remove(task)
+                    self._done.add(task)
+                    return {"ok": True, "requeued": True,
+                            "done": len(self._done), "queued": len(self._todo)}
                 return {"ok": False, "error": "not leased"}
             if self._leased[task]["worker"] != worker:
                 return {"ok": False, "error": "lease not owned"}
@@ -283,12 +312,20 @@ class InProcessCoordinator:
         with self._lock:
             self._kv.pop(key, None)
 
-    def kv_incr(self, key: str, delta: int = 1) -> int:
+    def kv_incr(self, key: str, delta: int = 1,
+                op_id: Optional[str] = None) -> int:
         """Atomic counter (matches the C++ op_kv_incr): read-modify-write
-        under the lock, so concurrent failure-count bumps cannot be lost."""
+        under the lock, so concurrent failure-count bumps cannot be lost.
+        ``op_id`` dedups replayed increments exactly-once (native parity:
+        the marker lives in the KV namespace)."""
         with self._lock:
+            marker = f"__edl_op/{op_id}" if op_id else None
+            if marker and marker in self._kv:
+                return int(self._kv[marker])
             cur = int(self._kv.get(key, "0") or "0") + int(delta)
             self._kv[key] = str(cur)
+            if marker:
+                self._kv[marker] = str(cur)
             return cur
 
     def status(self) -> Dict:
@@ -380,9 +417,9 @@ class InProcessClient:
         self._auth()
         return self._c.acquire_task(self.worker)
 
-    def acquire(self):
+    def acquire(self, req_id=None):
         self._auth()
-        return self._c.acquire(self.worker)
+        return self._c.acquire(self.worker, req_id=req_id)
 
     def complete_task(self, task):
         self._auth()
@@ -420,6 +457,23 @@ class InProcessClient:
     def kv_incr(self, key, delta=1):
         self._auth()
         return self._c.kv_incr(key, delta)
+
+    def call(self, op, timeout=None, **fields):
+        """Minimal wire-call shim for callers that speak raw ops (the
+        outbox replays through this); in-process calls never fail."""
+        self._auth()
+        if op == "complete_task":
+            return self._c.complete_task(self.worker, fields["task"])
+        if op == "fail_task":
+            return self._c.fail_task(self.worker, fields["task"])
+        if op == "kv_put":
+            self._c.kv_put(fields["key"], fields["value"])
+            return {"ok": True}
+        if op == "kv_incr":
+            value = self._c.kv_incr(fields["key"], fields.get("delta", 1),
+                                    op_id=fields.get("op_id"))
+            return {"ok": True, "value": value}
+        raise ValueError(f"unsupported in-process op {op!r}")
 
     def status(self):
         self._auth()

@@ -11,8 +11,9 @@ from __future__ import annotations
 import os
 import socket
 import subprocess
+import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
 
@@ -158,9 +159,101 @@ class CoordinatorServer:
                 self._proc.wait()
             self._proc = None
 
+    def restart(self, wait: float = 10.0) -> "CoordinatorServer":
+        """Bring a dead (or killed) coordinator back on the SAME port with
+        the same state_file + run_id, so it resumes its queue/done/kv and
+        reconnecting clients need no re-discovery. Stops any still-running
+        process first (idempotent under supervision races)."""
+        self.stop()
+        return self.start(wait=wait)
+
     def client(self, worker: str = "") -> CoordinatorClient:
         return CoordinatorClient(port=self.port, worker=worker,
                                  token=self.auth_token)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class CoordinatorSupervisor:
+    """Keep one coordinator process alive — the master-ReplicaSet role.
+
+    The reference delegates this to Kubernetes: the master Deployment's
+    ReplicaSet re-creates a dead master pod, and etcd preserves its state
+    (`pkg/controller.go:119-134`). Here a watch thread polls the child and
+    restarts it through :meth:`CoordinatorServer.restart` — same port, same
+    ``state_file``, same ``run_id`` — so the resurrected process resumes
+    the journal, bumps the epoch, and requeues live leases exactly as a
+    planned restart would. Workers ride the outage on their retry policy.
+
+    Metrics (``restarts``, ``downtime_seconds``, ``last_restart_rc``) feed
+    the collector's cluster samples.
+    """
+
+    def __init__(self, server: CoordinatorServer, poll_interval: float = 0.2,
+                 max_restarts: int = 100):
+        self.server = server
+        self.poll_interval = poll_interval
+        #: crash-loop bound: a coordinator that cannot stay up (bad state
+        #: path, port stolen) should fail the job, not flap forever.
+        self.max_restarts = max_restarts
+        self.restarts = 0
+        self.downtime_seconds = 0.0
+        self.last_restart_rc: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> "CoordinatorSupervisor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(  # edl: noqa[EDL001] lifecycle field; start/stop are owner-thread-only by contract
+            target=self._watch, name="edl-coordinator-supervisor", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _watch(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            rc = self.server.poll()
+            if rc is None:
+                continue
+            if self.restarts >= self.max_restarts:
+                return
+            down_at = time.monotonic()
+            try:
+                self.server.restart()
+            except CoordinatorError:
+                # Startup failed (port race with the dying process, transient
+                # fs error): loop and retry until max_restarts — supervision
+                # must outlive one bad attempt.
+                continue
+            finally:
+                with self._lock:
+                    self.last_restart_rc = rc
+                    self.restarts += 1
+                    self.downtime_seconds += time.monotonic() - down_at
+
+    def stop(self) -> None:
+        """Stop supervising, then stop the coordinator itself."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None  # edl: noqa[EDL001] lifecycle field; start/stop are owner-thread-only by contract
+        self.server.stop()
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "restarts": float(self.restarts),
+                "downtime_seconds": self.downtime_seconds,
+                "last_restart_rc": float(self.last_restart_rc)
+                if self.last_restart_rc is not None else -1.0,
+            }
 
     def __enter__(self):
         return self.start()

@@ -161,7 +161,7 @@ def start_coordinator(ctx: LaunchContext, block: bool = True):
     (`docker/paddle_k8s:26-32`, `pkg/jobparser.go:167-227`); our native
     service holds its own state, so there is no sidecar to babysit.
     """
-    from edl_tpu.coordinator.server import CoordinatorServer
+    from edl_tpu.coordinator.server import CoordinatorServer, CoordinatorSupervisor
 
     state_file = ctx.state_file or os.path.join(
         ctx.workspace or ".", f"{ctx.job_name}-coordinator-state.jsonl"
@@ -191,11 +191,24 @@ def start_coordinator(ctx: LaunchContext, block: bool = True):
                  added, len(ctx.data_shards), max(1, ctx.passes))
     if not block:
         return server
+    # Supervised: a crashed coordinator process is restarted in place (same
+    # port, same state_file, same run_id), so it resumes its journal and
+    # bumps the epoch — the master-ReplicaSet role the reference delegated
+    # to Kubernetes (`pkg/controller.go:119-134`). Only a crash LOOP past
+    # the supervisor's budget fails the pod.
+    supervisor = CoordinatorSupervisor(server)
+    supervisor.start()
     try:
-        rc = server.wait()
-        raise RuntimeError(f"coordinator exited rc={rc}")
+        while True:
+            rc = server.poll()
+            if rc is not None and supervisor.restarts >= supervisor.max_restarts:
+                raise RuntimeError(
+                    f"coordinator crash-looped (rc={rc}) after "
+                    f"{supervisor.restarts} restarts; giving up"
+                )
+            time.sleep(0.5)
     finally:
-        server.stop()
+        supervisor.stop()
 
 
 #: entry exit code meaning "world size changed: relaunch me at the new one".

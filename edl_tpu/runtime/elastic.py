@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import jax
 from jax.sharding import Mesh
 
+from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.models.base import Model
 from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
@@ -77,6 +78,13 @@ class ElasticConfig:
     #: the rescale restore window, so the first post-rescale step dispatches
     #: a ready executable instead of paying XLA inside the recovery budget.
     warm_compile: bool = True
+    #: coordinator-outage budget, seconds: while the coordinator is
+    #: unreachable the worker keeps stepping batches already leased (the
+    #: compute never depended on the control plane) and buffers
+    #: completions in an outbox; past this budget it checkpoints durably
+    #: and parks, polling for the coordinator's return. See
+    #: doc/robustness.md for the full failure model.
+    outage_budget: float = 60.0
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
 
@@ -122,7 +130,10 @@ class ElasticWorker:
         if not config.checkpoint_dir:
             raise ValueError("ElasticConfig.checkpoint_dir is required")
         self.model = model
-        self.client = client
+        #: degraded-mode facade: mutations buffer during a coordinator
+        #: outage and replay idempotently on reconnect; reads fail soft.
+        self.client = client if isinstance(client, OutboxClient) \
+            else OutboxClient(client)
         self.source = source
         self.config = config
         self.planner = device_planner or default_device_planner(4)
@@ -135,7 +146,13 @@ class ElasticWorker:
         self._epoch = -1
         self._world = 0
         self._prev_world = 0
+        self._rank = -1
         self._last_heartbeat = 0.0
+        #: True between observing the coordinator unreachable and the next
+        #: successful control-plane call — gates benign epoch adoption.
+        self._outage_open = False
+        #: times the worker hit the outage budget and parked.
+        self.parks = 0
         #: completion lag (at-least-once across hard crashes): shards whose
         #: updates the save initiated LAST is covering — their leases are
         #: completed once the NEXT save initiation proves that save durable
@@ -152,24 +169,100 @@ class ElasticWorker:
 
     # -- membership ------------------------------------------------------------
 
+    def _adopt(self, info: Dict) -> None:
+        self._epoch = info["epoch"]
+        self._world = max(1, info["world"])
+        self._rank = int(info.get("rank", -1))
+
     def _sync_membership(self) -> None:
         # run() entry = incarnation boundary: a predecessor's leases (same
         # pod name, relaunched after a crash) requeue for replay.
         info = self.client.register(takeover=True)
-        self._epoch = info["epoch"]
-        self._world = max(1, info["world"])
+        if not info.get("ok"):
+            info = self._register_blocking(takeover=True)
+        self._adopt(info)
+
+    def _register_blocking(self, takeover: bool = False) -> Dict:
+        """Re-register, waiting out a coordinator outage — the PARKED state.
+
+        ``takeover=False`` (the reconnect default) keeps our leases: the
+        coordinator restores/renews them for a returning worker, so an
+        outage shorter than the lease TTL never forfeits shards mid-
+        training. The first success replays the outbox (OutboxClient)
+        before we resume normal bookkeeping.
+        """
+        logged = False
+        while True:
+            reply = self.client.register(takeover=takeover)
+            if reply.get("ok"):
+                self._outage_open = False
+                if logged:
+                    log.info("coordinator back after %d park(s); outage "
+                             "telemetry: %s", self.parks, self.client.summary())
+                return reply
+            if not logged:
+                logged = True
+                log.warning("parked: waiting for coordinator (%s)",
+                            reply.get("error", "unreachable"))
+            time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
 
     def _epoch_changed(self, force: bool = False) -> bool:
-        """Heartbeat (rate-limited) and report whether membership moved."""
+        """Heartbeat (rate-limited) and report whether membership moved.
+
+        Degraded mode lives here: an unreachable coordinator is NOT an
+        epoch change while the outage stays inside ``outage_budget`` —
+        batches already leased keep stepping, side effects buffer. Past
+        the budget it reports True so run() checkpoints durably and parks.
+        """
         now = time.monotonic()
         if not force and now - self._last_heartbeat < self.config.heartbeat_interval:
             return False
         self._last_heartbeat = now
         reply = self.client.heartbeat()
+        if reply.get("unreachable"):
+            self._outage_open = True
+            outage = self.client.outage_seconds()
+            if outage > self.config.outage_budget:
+                log.warning(
+                    "coordinator unreachable %.1fs (budget %.1fs): "
+                    "checkpoint-and-park", outage, self.config.outage_budget)
+                return True
+            return False
+        rejoined = False
         if not reply.get("ok"):
-            # We were expired (e.g. long compile stall): rejoin.
-            reply = self.client.register()
-        return reply["epoch"] != self._epoch
+            # We were expired (long compile stall) or the coordinator
+            # restarted and forgot us: rejoin WITHOUT takeover — our leases
+            # must survive the re-register (we are still training them).
+            reply = self.client.register(takeover=False)
+            if reply.get("unreachable"):
+                self._outage_open = True
+                return self.client.outage_seconds() > self.config.outage_budget
+            if not reply.get("ok") or "epoch" not in reply:
+                # Repeated failure: fall back to the rendezvous path, which
+                # re-registers until membership settles.
+                return True
+            rejoined = True
+        if self._outage_open or rejoined:
+            self._outage_open = False
+            # Reconnected (or re-registered after the coordinator forgot
+            # us — an expiry, or a restart fast enough that the transport
+            # retries hid the outage). A restart bumps the epoch even when
+            # nobody joined or left; if world AND rank are unchanged the
+            # mesh is already right — adopt the new epoch without paying a
+            # rescale. Restricted to these paths: a bump_epoch with a
+            # stable world is the control plane's explicit rescale nudge
+            # and must still interrupt.
+            if (reply["epoch"] != self._epoch
+                    and int(reply.get("world", -1)) == self._world
+                    and int(reply.get("rank", -2)) == self._rank):
+                log.info("adopted epoch %s after outage (world/rank "
+                         "unchanged)", reply["epoch"])
+                self._epoch = reply["epoch"]
+                return False
+        if reply["epoch"] == self._epoch:
+            self._rank = int(reply.get("rank", self._rank))
+            return False
+        return True
 
     def _rendezvous(self) -> None:
         """Agree on (epoch, world) with every live member before building the
@@ -177,22 +270,32 @@ class ElasticWorker:
         arrived at the same epoch; if membership moves mid-wait we get
         resync=True with the new epoch and retry. On timeout we proceed —
         the checkpoint is already durable and stragglers restore from it.
+        An unreachable coordinator parks the rendezvous (checkpointed state
+        is durable; there is nothing useful to do but wait).
         """
-        for _ in range(64):
+        attempts = 0
+        while attempts < 64:
             reply = self.client.sync(
                 self._epoch, timeout=self.config.rescale_barrier_timeout
             )
             if reply.get("ok"):
                 self._world = max(1, reply["world"])
                 return
+            if reply.get("error") == "unreachable":
+                # Park: does not count against the thrash bound — waiting
+                # out an outage is not membership churn.
+                self._adopt(self._register_blocking(takeover=False))
+                continue
+            attempts += 1
             if reply.get("resync"):
                 self._epoch = reply["epoch"]
                 self._world = max(1, reply["world"])
                 continue
             if reply.get("error") == "unknown worker":
-                info = self.client.register()
-                self._epoch = info["epoch"]
-                self._world = max(1, info["world"])
+                info = self.client.register(takeover=False)
+                if not info.get("ok"):
+                    info = self._register_blocking(takeover=False)
+                self._adopt(info)
                 continue
             log.warning("rescale sync incomplete (%s); proceeding", reply)
             return
@@ -415,9 +518,11 @@ class ElasticWorker:
                             # The in-flight save landed: its shards are
                             # durable now — complete them immediately rather
                             # than holding leases until the next save
-                            # initiation.
-                            for task in self._pending_commit:
-                                self.client.complete_task(task)
+                            # initiation. (`done_task`, NOT `task`: the
+                            # enclosing loop's `task` is live for per-pass
+                            # step attribution below.)
+                            for done_task in self._pending_commit:
+                                self.client.complete_task(done_task)
                             self._pending_commit = []
                 except WireRestartRequired as e:
                     # Multi-process wire-codec overflow (only raised when
@@ -454,8 +559,10 @@ class ElasticWorker:
                         rescale = True
 
             if rescale:
-                # Membership changed: make state durable, then rendezvous at
-                # the top of the loop and rebuild at the agreed world size.
+                # Membership changed OR the outage budget expired: make
+                # state durable first. During an outage the completions
+                # buffer in the outbox — this is exactly checkpoint-and-
+                # park, and _register_blocking below is the park.
                 self._checkpoint_and_commit(state, None, block=True)
                 if self.config.restart_on_rescale:
                     from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
@@ -466,15 +573,25 @@ class ElasticWorker:
                     )
                     raise SystemExit(RESCALE_EXIT_CODE)
                 self._prev_world = world
-                info = self.client.register()  # refresh observed epoch/world
-                self._epoch = info["epoch"]
-                self._world = max(1, info["world"])
+                info = self.client.register(takeover=False)
+                if not info.get("ok"):  # refresh observed epoch/world
+                    self.parks += 1
+                    info = self._register_blocking(takeover=False)
+                self._adopt(info)
                 if len(self.rescales) >= max_rescales:
                     raise RuntimeError("too many rescales; aborting")
                 continue
 
             # Queue exhausted: final checkpoint, commit held leases, finish.
             self._checkpoint_and_commit(state, None, block=True)
+            # The final commit must actually LAND (not sit buffered): wait
+            # out any outage so no completed shard is lost with the process.
+            while len(self.client.outbox):
+                self._register_blocking(takeover=False)
+                if len(self.client.outbox):
+                    self.client.replay()
+                if len(self.client.outbox):
+                    time.sleep(0.2)
             total = time.perf_counter() - t_start
             if self.profiler is not None:
                 prof = {f"profile_{k}": v for k, v in self.profiler.summary().items()}
@@ -482,8 +599,11 @@ class ElasticWorker:
                 prof = {}
             if self.pass_steps:
                 log.info("per-pass steps: %s", dict(sorted(self.pass_steps.items())))
+            outage = {f"outage_{k}": v for k, v in self.client.summary().items()}
+            outage["outage_parks"] = float(self.parks)
             return {
                 **prof,
+                **outage,
                 "steps": float(self.steps_done),
                 "final_loss": self.losses[-1] if self.losses else float("nan"),
                 "world": float(self._world),

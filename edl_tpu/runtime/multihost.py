@@ -46,6 +46,8 @@ from typing import Dict, List, Optional
 import jax
 from jax.sharding import Mesh
 
+from edl_tpu.coordinator.client import CoordinatorAuthError, CoordinatorError
+from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.models.base import Model
 from edl_tpu.parallel import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
@@ -86,6 +88,12 @@ class MultiHostWorker:
         if not config.checkpoint_dir:
             raise ValueError("ElasticConfig.checkpoint_dir is required")
         self.model = model
+        # Degraded-mode facade: a coordinator outage buffers completions
+        # (rank 0's checkpoint commits) instead of killing the gang; the
+        # round machinery below holds the gang on the current round while
+        # the outage lasts, up to ``config.outage_budget``.
+        if not isinstance(client, OutboxClient):
+            client = OutboxClient(client)
         self.client = client
         self.source = source
         self.config = config
@@ -156,8 +164,25 @@ class MultiHostWorker:
         to our own held leases (flush before declaring exhausted) or the
         periodic interval elapsed."""
         hb = self.client.heartbeat()
+        while not hb.get("ok") and hb.get("unreachable"):
+            # Coordinator outage: hold the gang on this round. Peers polling
+            # this round's key stall on the same signal (their kv_get raises),
+            # so lockstep holds; past the budget the whole gang warm-restarts
+            # and the completion lag replays anything uncovered.
+            if self.client.outage_seconds() > self.config.outage_budget:
+                log.warning(
+                    "coordinator outage %.1fs exceeded budget %.1fs; "
+                    "gang restart", self.client.outage_seconds(),
+                    self.config.outage_budget)
+                return {"stop": "rescale"}
+            time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+            hb = self.client.heartbeat()
         if not hb.get("ok"):
             hb = self.client.register()
+            if not hb.get("ok") or "epoch" not in hb:
+                # Could not rejoin (membership thrash / unknown state):
+                # warm-restart rather than guessing an epoch.
+                return {"stop": "rescale"}
         if int(hb["epoch"]) != epoch:
             msg = {"stop": "rescale"}
         else:
@@ -186,7 +211,14 @@ class MultiHostWorker:
                     counts[task] = n
                 tasks.append(task)
             if not tasks:
-                st = self.client.status()
+                try:
+                    st = self.client.status()
+                except CoordinatorAuthError:
+                    raise
+                except CoordinatorError:
+                    # Outage mid-probe: "wait" is the safe verdict — never
+                    # declare exhaustion on missing information.
+                    st = {"queued": -1, "leased": -1}
                 queued = int(st.get("queued", 0))
                 leased = int(st.get("leased", 0))
                 if self._uncommitted:
@@ -213,7 +245,12 @@ class MultiHostWorker:
         keep: List[int] = []
         for r in self._plan_rounds:
             if r <= self._collective_hwm and r < rnd:
-                self.client.kv_del(ROUND_KEY.format(epoch=epoch, round=r))
+                try:
+                    self.client.kv_del(ROUND_KEY.format(epoch=epoch, round=r))
+                except CoordinatorAuthError:
+                    raise
+                except CoordinatorError:
+                    keep.append(r)  # GC is best-effort; retry next round
             else:
                 keep.append(r)
         self._plan_rounds = keep
@@ -221,14 +258,39 @@ class MultiHostWorker:
 
     def _poll_round(self, epoch: int, rnd: int, timeout: float) -> dict:
         """Ranks > 0: block on the round key; a timeout means rank 0 is gone
-        (or membership is thrashing) — treat as a rescale."""
+        (or membership is thrashing) — treat as a rescale.
+
+        A coordinator outage is NOT rank-0 death: while the transport keeps
+        failing, the liveness deadline is suspended and the wait is governed
+        by ``outage_budget`` instead. When the coordinator answers again the
+        deadline restarts fresh — rank 0 rode the same outage and gets a
+        full window to publish."""
         key = ROUND_KEY.format(epoch=epoch, round=rnd)
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            raw = self.client.kv_get(key)
+        down_since = None
+        while True:
+            try:
+                raw = self.client.kv_get(key)
+            except CoordinatorAuthError:
+                raise
+            except CoordinatorError:
+                if down_since is None:
+                    down_since = time.monotonic()
+                if time.monotonic() - down_since > self.config.outage_budget:
+                    log.warning(
+                        "round %d: coordinator outage exceeded budget %.1fs; "
+                        "assuming rescale", rnd, self.config.outage_budget)
+                    return {"stop": "rescale"}
+                time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+                continue
+            if down_since is not None:
+                down_since = None
+                deadline = time.monotonic() + timeout
             if raw:
                 return json.loads(raw)
-            self.client.heartbeat()
+            if time.monotonic() >= deadline:
+                break
+            self.client.heartbeat()  # fails soft under OutboxClient
             time.sleep(0.05)
         log.warning("round %d plan never arrived; assuming rescale", rnd)
         return {"stop": "rescale"}
@@ -326,7 +388,15 @@ class MultiHostWorker:
         world = jax.process_count()
         # Incarnation boundary: a warm-restarted worker's predecessor may
         # still hold leases under this pod name; requeue them for replay.
+        # A coordinator outage at startup (e.g. it is mid-restart under the
+        # supervisor) is ridden out up to the outage budget.
         info = self.client.register(takeover=True)
+        while not info.get("ok"):
+            if not info.get("unreachable") or (
+                    self.client.outage_seconds() > self.config.outage_budget):
+                self._exit_for_restart()
+            time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+            info = self.client.register(takeover=True)
         epoch = int(info["epoch"])
 
         mesh = self._build_mesh()
@@ -489,13 +559,32 @@ class MultiHostWorker:
         # need to read them to exit; the litter is bounded by one tail's
         # worth of rounds and dies with the job's coordinator.
         checkpoint_and_commit()
+        if rank == 0 and len(self.client.outbox):
+            # Completions buffered during an outage that is still open at
+            # drain time: give the coordinator one budget's grace to come
+            # back. Giving up is safe — the final checkpoint is durable, so
+            # the leases just expire and the next incarnation replays and
+            # re-completes those shards (at-least-once, never lost).
+            grace = time.monotonic() + self.config.outage_budget
+            while len(self.client.outbox) and time.monotonic() < grace:
+                if self.client.heartbeat().get("ok"):
+                    self.client.replay()
+                if len(self.client.outbox):
+                    time.sleep(0.2)
+            if len(self.client.outbox):
+                log.warning(
+                    "exiting with %d completions still buffered (coordinator "
+                    "unreachable); their leases will expire and replay",
+                    len(self.client.outbox))
         prof = (
             {f"profile_{k}": v for k, v in self.profiler.summary().items()}
             if self.profiler is not None
             else {}
         )
+        outage = {f"outage_{k}": v for k, v in self.client.summary().items()}
         return {
             **prof,
+            **outage,
             "steps": float(self.steps_done),
             "final_loss": self.losses[-1] if self.losses else float("nan"),
             "world": float(world),

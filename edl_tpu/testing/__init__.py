@@ -1,0 +1,5 @@
+"""Fault-injection helpers for resilience tests (not shipped runtime code)."""
+
+from edl_tpu.testing.chaosproxy import ChaosProxy
+
+__all__ = ["ChaosProxy"]

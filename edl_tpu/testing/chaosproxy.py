@@ -1,0 +1,259 @@
+"""ChaosProxy: a seeded, deterministic TCP fault-injection proxy.
+
+Sits between a coordinator client and the coordinator service and injects
+the transport failures the resilience machinery must survive:
+
+- **delays** — hold a chunk before forwarding (latency spike / GC pause);
+- **resets** — close both sides mid-stream (the peer sees ECONNRESET or a
+  clean EOF, i.e. ``CoordinatorUnreachable``);
+- **drops**  — swallow a chunk (the peer blocks until its read timeout,
+  i.e. ``CoordinatorTimeout`` — the "request fate unknown" case that the
+  req_id/op_id dedup machinery exists for);
+- **partitions** — :meth:`partition` severs every live connection and
+  resets new ones on arrival until :meth:`heal`, modeling a network split
+  or a coordinator restart window.
+
+Determinism: every fault decision comes from a ``random.Random`` seeded
+by ``(seed, connection-index, direction)`` — integers only, so runs are
+reproducible regardless of PYTHONHASHSEED or thread scheduling. The same
+seed against the same connection/request sequence yields the same faults.
+
+The proxy is transport-level only: it never parses the coordinator
+protocol, so it exercises exactly what a real middlebox failure would.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger("edl_tpu.testing.chaosproxy")
+
+__all__ = ["ChaosProxy"]
+
+
+def _hard_close(sock: socket.socket) -> None:
+    """Close with RST semantics where possible (no lingering FIN handshake),
+    so the peer observes the abrupt death a crashed process produces."""
+    try:
+        sock.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER,
+            __import__("struct").pack("ii", 1, 0),
+        )
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class _ConnPair:
+    """One proxied connection: the client socket and its upstream twin."""
+
+    def __init__(self, client: socket.socket, upstream: socket.socket):
+        self.client = client
+        self.upstream = upstream
+        self._closed = threading.Event()
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        # shutdown() before close(): the twin pump may be blocked in recv()
+        # on the other socket, and its in-kernel syscall pins the file — a
+        # bare close() would neither wake it nor send FIN/RST, leaving the
+        # proxied peer hung forever. shutdown() tears the connection down
+        # and wakes blocked readers regardless of who holds the fd.
+        for sock in (self.client, self.upstream):
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            _hard_close(sock)
+
+
+class ChaosProxy:
+    """Deterministic TCP fault injector between one client and one target.
+
+    Fault probabilities are per forwarded chunk and per direction; with all
+    probabilities zero the proxy is a transparent relay (useful as the
+    baseline of a chaos test: same topology, no faults).
+
+    ``stats`` counts what was actually injected so tests can assert the
+    chaos happened (a chaos test whose faults never fired proves nothing).
+    """
+
+    def __init__(
+        self,
+        target_port: int,
+        target_host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        seed: int = 0,
+        delay_prob: float = 0.0,
+        delay_range: Tuple[float, float] = (0.005, 0.05),
+        reset_prob: float = 0.0,
+        drop_prob: float = 0.0,
+    ):
+        self.target = (target_host, target_port)
+        self.seed = seed
+        self.delay_prob = delay_prob
+        self.delay_range = delay_range
+        self.reset_prob = reset_prob
+        self.drop_prob = drop_prob
+        self._lock = threading.Lock()
+        self._partitioned = False
+        self._conns: List[_ConnPair] = []
+        self._conn_seq = 0
+        self.stats: Dict[str, int] = {
+            "connections": 0, "delays": 0, "resets": 0,
+            "drops": 0, "refused": 0,
+        }
+        self._stop = threading.Event()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("127.0.0.1", port or 0))
+        self._lsock.listen(64)
+        self.port: int = self._lsock.getsockname()[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "ChaosProxy":
+        if self._thread is None:
+            self._thread = threading.Thread(  # edl: noqa[EDL001] lifecycle field; start/close are owner-thread-only by contract
+                target=self._accept_loop, name="edl-chaosproxy", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        _hard_close(self._lsock)
+        with self._lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for pair in conns:
+            pair.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None  # edl: noqa[EDL001] lifecycle field; start/close are owner-thread-only by contract
+
+    def __enter__(self) -> "ChaosProxy":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- partition control -----------------------------------------------------
+
+    def partition(self) -> None:
+        """Sever every live connection and reset new ones until heal().
+
+        From the client's perspective this is indistinguishable from the
+        coordinator process dying: in-flight requests see EOF/RST
+        (``CoordinatorUnreachable``) and reconnects are refused."""
+        with self._lock:
+            self._partitioned = True
+            conns = list(self._conns)
+            self._conns.clear()
+        for pair in conns:  # close outside the lock: peers may be mid-recv
+            pair.close()
+        log.info("partitioned (%d connections severed)", len(conns))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitioned = False
+        log.info("healed")
+
+    @property
+    def partitioned(self) -> bool:
+        with self._lock:
+            return self._partitioned
+
+    # -- data path -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._lsock.accept()
+            except OSError:
+                return  # listener closed by close()
+            with self._lock:
+                partitioned = self._partitioned
+                self._conn_seq += 1
+                cid = self._conn_seq
+                if partitioned:
+                    self.stats["refused"] += 1
+            if partitioned:
+                _hard_close(client)
+                continue
+            try:
+                upstream = socket.create_connection(self.target, timeout=5.0)
+            except OSError:
+                # Target genuinely down: behave like it (reset the client).
+                with self._lock:
+                    self.stats["refused"] += 1
+                _hard_close(client)
+                continue
+            pair = _ConnPair(client, upstream)
+            with self._lock:
+                self._conns.append(pair)
+                self.stats["connections"] += 1
+            # Integer-mixed seeds: deterministic under PYTHONHASHSEED and
+            # independent per direction, so thread interleaving between the
+            # two pumps cannot perturb either one's fault sequence.
+            base = self.seed * 1_000_003 + cid * 2
+            for src, dst, rng_seed, name in (
+                (client, upstream, base, f"c2s-{cid}"),
+                (upstream, client, base + 1, f"s2c-{cid}"),
+            ):
+                threading.Thread(
+                    target=self._pump,
+                    args=(pair, src, dst, random.Random(rng_seed)),
+                    name=f"edl-chaosproxy-{name}",
+                    daemon=True,
+                ).start()
+
+    def _pump(self, pair: _ConnPair, src: socket.socket,
+              dst: socket.socket, rng: random.Random) -> None:
+        import time
+
+        try:
+            while not self._stop.is_set():
+                try:
+                    data = src.recv(65536)
+                except OSError:
+                    break
+                if not data:
+                    break
+                roll = rng.random()
+                if roll < self.reset_prob:
+                    with self._lock:
+                        self.stats["resets"] += 1
+                    pair.close()
+                    break
+                if roll < self.reset_prob + self.drop_prob:
+                    with self._lock:
+                        self.stats["drops"] += 1
+                    continue  # swallowed: the peer waits out its timeout
+                if roll < self.reset_prob + self.drop_prob + self.delay_prob:
+                    with self._lock:
+                        self.stats["delays"] += 1
+                    time.sleep(rng.uniform(*self.delay_range))
+                try:
+                    dst.sendall(data)
+                except OSError:
+                    break
+        finally:
+            pair.close()
+            with self._lock:
+                if pair in self._conns:
+                    self._conns.remove(pair)

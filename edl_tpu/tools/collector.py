@@ -41,6 +41,11 @@ class ClusterSample:
     cpu_utilization: float = 0.0
     tpu_utilization: float = 0.0
     memory_utilization: float = 0.0
+    #: coordinator-supervision health (restarts, downtime_seconds,
+    #: last_restart_rc) when a supervisor is attached — the control plane's
+    #: own availability belongs on the same metrics plane as the jobs it
+    #: schedules.
+    coordinator: Dict[str, float] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         return {
@@ -53,6 +58,7 @@ class ClusterSample:
             "cpu_utilization": round(self.cpu_utilization, 4),
             "tpu_utilization": round(self.tpu_utilization, 4),
             "memory_utilization": round(self.memory_utilization, 4),
+            "coordinator": {k: round(v, 4) for k, v in self.coordinator.items()},
         }
 
 
@@ -70,11 +76,16 @@ class Collector:
         period_seconds: float = 10.0,
         sink: Optional[TextIO] = None,
         max_samples: int = 100_000,
+        supervisor=None,
     ):
         self.store = store
         self.cluster = cluster
         self.period_seconds = period_seconds
         self.sink = sink
+        #: optional CoordinatorSupervisor (or anything with ``summary() ->
+        #: Dict[str, float]``): its restart/downtime counters ride along in
+        #: every sample.
+        self.supervisor = supervisor
         self.samples: List[ClusterSample] = []
         self._max = max_samples
         self._stop = threading.Event()
@@ -108,6 +119,10 @@ class Collector:
             cpu_utilization=snap.util("cpu"),
             tpu_utilization=snap.util("tpu"),
             memory_utilization=snap.util("memory"),
+            coordinator=(
+                dict(self.supervisor.summary())
+                if self.supervisor is not None else {}
+            ),
         )
         self.samples.append(s)
         if len(self.samples) > self._max:

@@ -371,6 +371,16 @@ class Coordinator {
     record(JsonWriter().field("k", "kv").field("key", key)
                .field("value", value).done());
   }
+  // Lease ownership journal: worker="" clears (requeue). Persisting leases
+  // means a coordinator restart preserves who holds what — a live worker
+  // reconnecting within the lease TTL keeps its shards, so an outage can
+  // never hand a shard that is mid-training to a second worker (the
+  // exactly-once half of the chaos criterion). Truly-dead holders still
+  // requeue via normal TTL expiry after the restart.
+  void record_lease(const std::string& task, const std::string& worker) {
+    record(JsonWriter().field("k", "lease").field("task", task)
+               .field("worker", worker).done());
+  }
   void record_kv_del(const std::string& key) {
     record(JsonWriter().field("k", "kvdel").field("key", key).done());
   }
@@ -418,6 +428,7 @@ class Coordinator {
       leased_.erase(t);
       todo_.push_back(t);
       todo_set_.insert(t);
+      record_lease(t, "");
     }
   }
 
@@ -447,6 +458,9 @@ class Coordinator {
   std::deque<std::string> todo_;
   std::set<std::string> todo_set_;  // mirrors todo_ for O(log n) dedup
   std::map<std::string, Lease> leased_;   // task -> lease
+  // Last acquire per worker: worker -> (req_id, task). Lets a retried
+  // acquire (lost reply) return the same lease instead of a second task.
+  std::map<std::string, std::pair<std::string, std::string>> acquire_cache_;
   std::set<std::string> done_;
   std::map<std::string, Barrier> barriers_;
   // Epoch-synchronized rendezvous (the rescale sync point): workers call
@@ -468,8 +482,9 @@ class Coordinator {
 // Durable state is JSON-lines so it reuses the wire parser/writer. A file is
 // a snapshot prefix plus appended delta records; load replays them in order:
 //   {"k":"meta","epoch":N,"run_id":R}
-//   {"k":"todo","tasks":[...]}      (todo + live leases: restart requeues)
+//   {"k":"todo","tasks":[...]}
 //   {"k":"done","tasks":[...]}
+//   {"k":"lease","task":T,"worker":W}  (W="" clears; last record wins)
 //   {"k":"kv","key":K,"value":V}    (one line per entry)
 //   {"k":"kvdel","key":K}           (delta only)
 bool Coordinator::save_snapshot() {
@@ -481,11 +496,13 @@ bool Coordinator::save_snapshot() {
   out += JsonWriter().field("k", "meta").field("epoch", (double)epoch_)
              .field("run_id", run_id_).done();
   std::vector<std::string> todo(todo_.begin(), todo_.end());
-  // Live leases are worker-held state; after a restart those workers'
-  // connections (and ranks) are gone, so their tasks go back to the queue —
-  // at-least-once, exactly what lease expiry would have done.
-  for (auto& [task, _] : leased_) todo.push_back(task);
   out += JsonWriter().field("k", "todo").field("tasks", todo).done();
+  // Live leases persist WITH their holder: a restarted coordinator grants
+  // each lease a fresh TTL, so a worker that rode out the outage keeps its
+  // shards (no double-assign) and a dead worker's shards requeue on expiry.
+  for (auto& [task, lease] : leased_)
+    out += JsonWriter().field("k", "lease").field("task", task)
+               .field("worker", lease.worker).done();
   std::vector<std::string> done(done_.begin(), done_.end());
   out += JsonWriter().field("k", "done").field("tasks", done).done();
   for (auto& [key, value] : kv_)
@@ -521,6 +538,7 @@ void Coordinator::load_state() {
   // excluding completed work.
   std::vector<std::string> todo_order;
   std::set<std::string> todo_seen;
+  std::map<std::string, std::string> lease_of;  // last lease record wins
   std::string file_run_id;
   long long file_epoch = 0;
   long long file_records = 0;
@@ -551,6 +569,14 @@ void Coordinator::load_state() {
           todo_order.push_back(t);
         }
       }
+    } else if (kind == "lease") {
+      std::string t = get_str(obj, "task");
+      if (!t.empty()) {
+        lease_of[t] = get_str(obj, "worker");
+        // A lease implies the task exists even if its todo line predates
+        // this file's snapshot horizon.
+        if (todo_seen.insert(t).second) todo_order.push_back(t);
+      }
     } else if (kind == "kv") {
       kv_[get_str(obj, "key")] = get_str(obj, "value");
       restored_kv++;
@@ -574,8 +600,15 @@ void Coordinator::load_state() {
     need_snapshot_ = true;  // rewrite the file under our identity
     return;
   }
+  double lease_deadline = now_sec() + task_lease_sec_;
   for (auto& t : todo_order) {
-    if (!done_.count(t)) {
+    if (done_.count(t)) continue;
+    auto lit = lease_of.find(t);
+    if (lit != lease_of.end() && !lit->second.empty()) {
+      // Restore the lease under its holder with a fresh TTL: the worker
+      // reconnects (register/heartbeat renews) or expiry requeues it.
+      leased_[t] = Lease{t, lit->second, lease_deadline};
+    } else {
       todo_.push_back(t);
       todo_set_.insert(t);
     }
@@ -591,8 +624,9 @@ void Coordinator::load_state() {
   // (O(total mutations ever) disk + parse time).
   appended_records_ = file_records;
   fprintf(stderr,
-          "edl-coordinator restored state: epoch=%lld todo=%zu done=%zu kv=%d\n",
-          epoch_, todo_.size(), done_.size(), restored_kv);
+          "edl-coordinator restored state: epoch=%lld todo=%zu leased=%zu "
+          "done=%zu kv=%d\n",
+          epoch_, todo_.size(), leased_.size(), done_.size(), restored_kv);
 }
 
 bool Coordinator::maybe_save_state() {
@@ -673,6 +707,7 @@ void Coordinator::drop_member(const std::string& name) {
     // Requeue this worker's leases immediately: a departed trainer's chunk
     // goes back to the queue (master semantics on task timeout).
     requeue_worker_leases(name);
+    acquire_cache_.erase(name);
     release_sync(false);
   }
 }
@@ -685,6 +720,7 @@ void Coordinator::requeue_expired_leases(double now) {
     leased_.erase(t);
     todo_.push_back(t);
     todo_set_.insert(t);
+    record_lease(t, "");
   }
 }
 
@@ -780,6 +816,23 @@ std::string Coordinator::op_add_tasks(const JsonObject& req) {
 
 std::string Coordinator::op_acquire_task(const JsonObject& req) {
   std::string worker = get_str(req, "worker");
+  std::string req_id = get_str(req, "req_id");
+  // Dedup: a client that lost the reply retries the SAME logical acquire
+  // (same req_id). Without this, the retry would pop a second task while
+  // the first sits leased forever — renewed by every heartbeat, never
+  // trained, so the queue never drains. Answer from the cache as long as
+  // the cached task is still this worker's lease.
+  if (!req_id.empty()) {
+    auto cit = acquire_cache_.find(worker);
+    if (cit != acquire_cache_.end() && cit->second.first == req_id) {
+      auto lit = leased_.find(cit->second.second);
+      if (lit != leased_.end() && lit->second.worker == worker) {
+        lit->second.deadline = now_sec() + task_lease_sec_;
+        return JsonWriter().field("ok", true).field("task", cit->second.second)
+            .field("lease_sec", task_lease_sec_).field("duplicate", true).done();
+      }
+    }
+  }
   if (todo_.empty()) {
     bool all_done = leased_.empty();
     return JsonWriter().field("ok", true).field_null("task")
@@ -789,6 +842,8 @@ std::string Coordinator::op_acquire_task(const JsonObject& req) {
   todo_.pop_front();
   todo_set_.erase(task);
   leased_[task] = Lease{task, worker, now_sec() + task_lease_sec_};
+  record_lease(task, worker);
+  if (!req_id.empty()) acquire_cache_[worker] = {req_id, task};
   return JsonWriter().field("ok", true).field("task", task)
       .field("lease_sec", task_lease_sec_).done();
 }
@@ -796,9 +851,33 @@ std::string Coordinator::op_acquire_task(const JsonObject& req) {
 std::string Coordinator::op_complete_task(const JsonObject& req) {
   std::string task = get_str(req, "task");
   std::string worker = get_str(req, "worker");
+  // Idempotent: outbox replay after a reconnect (or a retry whose first
+  // send did land) re-delivers completions. Already-done is success, not
+  // an error — anything else forces callers to special-case replays.
+  if (done_.count(task))
+    return JsonWriter().field("ok", true).field("duplicate", true)
+        .field("done", (double)done_.size())
+        .field("queued", (double)todo_.size()).done();
   auto it = leased_.find(task);
-  if (it == leased_.end())
+  if (it == leased_.end()) {
+    // Requeued-but-unleased (lease expired during an outage, or a restart
+    // pushed live leases back to todo): the completing worker trained the
+    // shard and has a durable covering checkpoint — that is the only
+    // reason workers ever call complete — so accepting here prevents a
+    // pointless second training pass. A task this run has never heard of
+    // is still an error.
+    if (todo_set_.count(task)) {
+      todo_set_.erase(task);
+      for (auto dit = todo_.begin(); dit != todo_.end(); ++dit)
+        if (*dit == task) { todo_.erase(dit); break; }
+      done_.insert(task);
+      record_done(task);
+      return JsonWriter().field("ok", true).field("requeued", true)
+          .field("done", (double)done_.size())
+          .field("queued", (double)todo_.size()).done();
+    }
     return JsonWriter().field("ok", false).field("error", "not leased").done();
+  }
   // A stale worker (lease expired, task re-leased elsewhere) must not be able
   // to complete another worker's lease out from under it.
   if (it->second.worker != worker)
@@ -821,6 +900,7 @@ std::string Coordinator::op_fail_task(const JsonObject& req) {
   leased_.erase(it);
   todo_.push_back(task);
   todo_set_.insert(task);
+  record_lease(task, "");
   return JsonWriter().field("ok", true).done();
 }
 
@@ -906,6 +986,22 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   std::string key = get_str(req, "key");
   if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
   long long delta = (long long)get_num(req, "delta", 1.0);
+  // Exactly-once under retries AND restarts: an op_id marker is persisted
+  // through the same KV journal as the counter itself, so a replayed
+  // increment (client retry after a lost reply, outbox replay after the
+  // coordinator came back) returns the originally-recorded value instead
+  // of double-counting — failure budgets stay honest across outages.
+  std::string op_id = get_str(req, "op_id");
+  std::string marker = op_id.empty() ? "" : "__edl_op/" + op_id;
+  if (!marker.empty()) {
+    auto mit = kv_.find(marker);
+    if (mit != kv_.end()) {
+      long long seen = 0;
+      try { seen = std::stoll(mit->second); } catch (...) { seen = 0; }
+      return JsonWriter().field("ok", true).field("value", (double)seen)
+          .field("duplicate", true).done();
+    }
+  }
   long long cur = 0;
   auto it = kv_.find(key);
   if (it != kv_.end()) {
@@ -916,6 +1012,10 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   cur += delta;
   kv_[key] = std::to_string(cur);
   record_kv(key, kv_[key]);
+  if (!marker.empty()) {
+    kv_[marker] = std::to_string(cur);
+    record_kv(marker, kv_[marker]);
+  }
   return JsonWriter().field("ok", true).field("value", (double)cur).done();
 }
 

@@ -66,6 +66,7 @@ def _job(name, server, entry, launcher, ckpt, tmp_path):
     }))
 
 
+@pytest.mark.chaos
 def test_two_jobs_survive_random_pod_kills(tmp_path):
     ensure_built()
     rng = random.Random(0)

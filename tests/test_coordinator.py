@@ -320,10 +320,12 @@ def test_native_default_bind_is_loopback_only():
 
 def test_native_state_survives_kill_and_restart(tmp_path):
     """SIGKILL the coordinator mid-job and restart it on the same state file:
-    the done-set survives (no full dataset replay), live leases requeue, and
-    the epoch moves forward so reconnecting workers re-rendezvous (VERDICT
-    missing #3b — the reference persisted this via its etcd sidecar,
-    /root/reference/pkg/jobparser.go:167-184)."""
+    the done-set survives (no full dataset replay), live leases are restored
+    UNDER THEIR HOLDER with a fresh TTL (so a worker that rode out the outage
+    keeps its shard and nobody double-trains it; a dead holder's shard
+    requeues on expiry), and the epoch moves forward so reconnecting workers
+    re-rendezvous (VERDICT missing #3b — the reference persisted this via its
+    etcd sidecar, /root/reference/pkg/jobparser.go:167-184)."""
     if not has_toolchain():
         pytest.skip("no C++ toolchain")
     state = str(tmp_path / "coord-state.jsonl")
@@ -348,7 +350,10 @@ def test_native_state_survives_kill_and_restart(tmp_path):
     finally:
         server.kill()  # hard crash: no graceful shutdown path
 
-    server2 = CoordinatorServer(port=port, state_file=state)
+    # Short lease TTL so the dead-holder expiry half of the semantics is
+    # testable without a 16 s wait.
+    server2 = CoordinatorServer(port=port, state_file=state,
+                                task_lease_sec=0.5)
     server2.start()
     try:
         w = server2.client("w0")
@@ -356,18 +361,58 @@ def test_native_state_survives_kill_and_restart(tmp_path):
         assert int(info["epoch"]) > epoch_before  # restart is a membership event
         st = w.status()
         assert int(st["done"]) == 2              # done-set survived: no replay
-        assert int(st["queued"]) == 4            # 3 todo + 1 requeued live lease
+        assert int(st["queued"]) == 3            # untouched todo only
+        assert int(st["leased"]) == 1            # live lease survived WITH holder
         assert w.kv_get("edl/ckpt_meta") == "step=200"
+        # The surviving holder can complete its restored lease directly —
+        # exactly what a worker draining its outbox after reconnect does.
+        assert w.complete_task(leased_not_done).get("ok")
         remaining = set()
         while True:
             t = w.acquire_task()
             if t is None:
                 break
             remaining.add(t)
-        assert leased_not_done in remaining      # at-least-once: lease replayed
+            w.complete_task(t)
+        assert len(remaining) == 3               # the 3 never-touched shards
         assert not remaining & set(done_tasks)   # completed work NOT replayed
+        assert leased_not_done not in remaining  # ...and no double-assign
     finally:
         server2.stop()
+
+    # Dead-holder path: crash again with w1 holding a lease, restart, and
+    # let the restored lease EXPIRE (w1 never reconnects): the shard then
+    # requeues for the survivors — at-least-once, nothing lost.
+    server3 = CoordinatorServer(port=port, state_file=state,
+                                task_lease_sec=0.5)
+    server3.start()
+    try:
+        w1 = server3.client("w1")
+        w1.register()
+        w1.add_tasks(["orphan-shard"])
+        orphan = w1.acquire_task()
+        assert orphan == "orphan-shard"
+    finally:
+        server3.kill()
+    server4 = CoordinatorServer(port=port, state_file=state,
+                                task_lease_sec=0.5)
+    server4.start()
+    try:
+        w = server4.client("w0")
+        w.register()
+        deadline = time.monotonic() + 10.0
+        recovered = None
+        while time.monotonic() < deadline:
+            t = w.acquire_task()
+            if t == orphan:
+                recovered = t
+                break
+            if t is not None:
+                w.complete_task(t)
+            time.sleep(0.1)
+        assert recovered == orphan  # expired orphan lease requeued
+    finally:
+        server4.stop()
 
 
 def test_native_state_run_id_mismatch_discards(tmp_path):
@@ -586,7 +631,9 @@ def test_native_durability_random_ops_survive_kill(tmp_path):
     """Property test for the delta log: after ANY sequence of acked mutations
     and a kill -9 at an arbitrary point, a restart restores exactly the acked
     state — done-set and KV match a Python model; every non-done task is
-    (re)leasable. Ack-after-durability makes every kill point equivalent."""
+    either back in the queue or restored as this worker's own live lease
+    (never both, never neither). Ack-after-durability makes every kill point
+    equivalent."""
     if not has_toolchain():
         pytest.skip("no C++ toolchain")
     import random
@@ -640,13 +687,19 @@ def test_native_durability_random_ops_survive_kill(tmp_path):
             assert int(st["done"]) == len(model_done), (trial, st)
             for k in (f"k{j}" for j in range(8)):
                 assert w.kv_get(k) == model_kv.get(k), (trial, k)
-            # every added-but-not-done task is leasable exactly once
+            # Leases held at the kill are restored UNDER w0 (not requeued),
+            # so they are not re-acquirable; everything else added-but-not-
+            # done is leasable exactly once. Ledger balance: queue + own
+            # leases == added - done, with no overlap.
+            leftover = set(leased)
+            assert int(st["leased"]) == len(leftover), (trial, st)
             remaining = set()
             while True:
                 t = w.acquire_task()
                 if t is None:
                     break
                 remaining.add(t)
-            assert remaining == model_added - model_done, trial
+            assert remaining == model_added - model_done - leftover, trial
+            assert not (remaining & leftover), trial
         finally:
             server2.stop()

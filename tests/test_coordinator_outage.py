@@ -1,0 +1,595 @@
+"""Coordinator-outage resilience: retry policy, outbox, chaos proxy, restart.
+
+The executable half of ``doc/robustness.md``: every row of the
+failure→recovery table has a test here. Fast cases run in tier-1 and are
+marked ``chaos``; the process-kill soak at the bottom is ``slow + chaos``
+(``make chaos`` runs everything).
+
+Determinism: every fault sequence comes from seeded RNGs — the
+``RetryPolicy`` seed fixes the backoff jitter, the ``ChaosProxy`` seed
+fixes which chunks get delayed/reset/dropped. A failing run replays
+bit-identically.
+"""
+
+import sys
+import threading
+import time
+
+import pytest
+
+from edl_tpu.coordinator import (
+    CoordinatorAuthError,
+    CoordinatorServer,
+    CoordinatorUnreachable,
+    InProcessCoordinator,
+    Outbox,
+    OutboxClient,
+    RetryPolicy,
+)
+from edl_tpu.coordinator.client import CoordinatorClient
+from edl_tpu.coordinator.server import CoordinatorSupervisor, free_port
+from edl_tpu.testing import ChaosProxy
+
+from tests.test_coordinator import has_toolchain
+
+needs_native = pytest.mark.skipif(
+    not has_toolchain(), reason="native toolchain unavailable"
+)
+
+pytestmark = pytest.mark.chaos
+
+
+# -- retry policy --------------------------------------------------------------
+
+
+def test_retry_policy_deterministic_and_bounded():
+    a = RetryPolicy(seed=7)
+    b = RetryPolicy(seed=7)
+    sa, sb = a.sleeps(), b.sleeps()
+    seq = [next(sa) for _ in range(8)]
+    assert seq == [next(sb) for _ in range(8)]
+    # jittered exponential: positive, bounded by max_backoff * (1 + jitter)
+    assert all(0 < s <= a.max_backoff * (1.0 + a.jitter) for s in seq)
+    # a different seed jitters differently
+    sc = RetryPolicy(seed=8).sleeps()
+    assert seq != [next(sc) for _ in range(8)]
+
+
+def test_retry_policy_backoff_grows():
+    seq = []
+    gen = RetryPolicy(seed=1, jitter=0.0).sleeps()
+    for _ in range(5):
+        seq.append(next(gen))
+    assert seq == sorted(seq)  # no jitter -> pure exponential up to the cap
+
+
+# -- typed errors / fail-fast auth ---------------------------------------------
+
+
+def test_unreachable_raised_after_deadline():
+    dead = free_port()
+    t0 = time.monotonic()
+    with pytest.raises(CoordinatorUnreachable):
+        CoordinatorClient(port=dead, connect_timeout=0.5,
+                          retry=RetryPolicy(deadline=0.5, seed=0))
+    assert time.monotonic() - t0 < 5.0
+
+
+@needs_native
+def test_auth_error_fails_fast_no_retry():
+    with CoordinatorServer(auth_token="right-secret") as server:
+        c = CoordinatorClient(port=server.port, worker="w0",
+                              token="wrong-secret",
+                              retry=RetryPolicy(deadline=30.0, seed=0))
+        t0 = time.monotonic()
+        with pytest.raises(CoordinatorAuthError):
+            c.register()
+        # fail-fast: no backoff loop burned the 30 s retry budget
+        assert time.monotonic() - t0 < 5.0
+        assert c.retry_count == 0
+        c.close()
+
+
+@needs_native
+def test_barrier_and_sync_distinguish_unreachable_from_timeout():
+    with CoordinatorServer() as server:
+        c = server.client("w0")
+        c.register()
+        late = server.client("w-late")
+        late.register()  # a member that never reaches the sync point
+        # live coordinator, missing peers: a genuine rendezvous timeout
+        assert c.barrier("b", count=2, timeout=0.4) == {
+            "ok": False, "error": "timeout"}
+        epoch = int(c.status()["epoch"])
+        reply = c.sync(epoch, timeout=0.4)
+        assert reply.get("ok") is False
+        assert reply.get("error") == "timeout" or reply.get("resync"), reply
+        late.close()
+        c.close()
+        # second client outlives the server (the `with` exit stops it)
+        c2 = CoordinatorClient(port=server.port, worker="w1",
+                               retry=RetryPolicy(deadline=0.5, seed=0))
+        c2.register()
+    # dead coordinator: transport failure must NOT masquerade as "timeout"
+    assert c2.barrier("b", count=2, timeout=0.4) == {
+        "ok": False, "error": "unreachable"}
+    assert c2.sync(0, timeout=0.4) == {"ok": False, "error": "unreachable"}
+    c2.close()
+
+
+# -- chaos proxy: transport faults ---------------------------------------------
+
+
+@needs_native
+def test_client_retries_through_proxy_resets():
+    with CoordinatorServer() as server:
+        with ChaosProxy(server.port, seed=11, reset_prob=0.2) as proxy:
+            c = CoordinatorClient(port=proxy.port, worker="w0",
+                                  retry=RetryPolicy(deadline=30.0, seed=11))
+            c.register()
+            for i in range(40):
+                c.kv_put(f"k{i}", str(i))
+            for i in range(40):
+                assert c.kv_get(f"k{i}") == str(i)
+            c.close()
+        assert proxy.stats["resets"] > 0, proxy.stats
+        assert proxy.stats["connections"] > 1  # re-dialed after resets
+
+
+@needs_native
+def test_chaos_proxy_is_deterministic():
+    """Same seed + same request sequence -> same injected fault counts."""
+    stats = []
+    for _ in range(2):
+        with CoordinatorServer() as server:
+            with ChaosProxy(server.port, seed=5, reset_prob=0.15) as proxy:
+                c = CoordinatorClient(port=proxy.port, worker="w0",
+                                      retry=RetryPolicy(deadline=30.0, seed=5))
+                c.register()
+                for i in range(25):
+                    c.kv_put(f"k{i}", "v")
+                c.close()
+                stats.append((proxy.stats["resets"], proxy.stats["drops"]))
+    assert stats[0] == stats[1], stats
+
+
+@needs_native
+def test_partition_buffers_mutations_then_replays():
+    """Outbox degraded mode end to end: mutations during a partition buffer,
+    heal replays them in order, and the replayed completion is recorded
+    exactly once (a second complete after reconnect replies duplicate)."""
+    with CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0) as server:
+        with ChaosProxy(server.port, seed=1) as proxy:
+            raw = CoordinatorClient(port=proxy.port, worker="w0",
+                                    retry=RetryPolicy(deadline=1.0, seed=1))
+            c = OutboxClient(raw)
+            c.register()
+            c.add_tasks(["s0", "s1"])
+            t = c.acquire_task()
+            assert t == "s0"
+
+            proxy.partition()
+            reply = c.complete_task("s0")
+            assert reply.get("buffered") is True
+            c.kv_put("during-outage", "x")
+            assert len(c.outbox) == 2
+            assert c.unreachable and c.outage_seconds() >= 0.0
+            # reads fail soft: the lease loop's poll path, not a crash
+            soft = c.acquire()
+            assert soft.get("task") is None and soft.get("unreachable")
+
+            proxy.heal()
+            # first successful guarded call replays the outbox
+            deadline = time.monotonic() + 20.0
+            while len(c.outbox) and time.monotonic() < deadline:
+                c.heartbeat()
+                time.sleep(0.05)
+            assert len(c.outbox) == 0
+            assert not c.unreachable
+
+            st = c.status()
+            assert int(st["done"]) == 1
+            assert c.kv_get("during-outage") == "x"
+            # duplicate completion after reconnect: idempotent, still done=1
+            again = c.complete_task("s0")
+            assert again.get("ok") and again.get("duplicate")
+            assert int(c.status()["done"]) == 1
+            summ = c.summary()
+            assert summ["outages"] >= 1.0 and summ["replayed_ops"] >= 2.0
+            raw.close()
+
+
+# -- server-side idempotence / dedup -------------------------------------------
+
+
+@needs_native
+def test_complete_task_idempotent_and_requeue_tolerant():
+    with CoordinatorServer() as server:
+        c = server.client("w0")
+        c.register()
+        c.add_tasks(["a", "b"])
+        assert c.acquire_task() == "a"
+        assert c.complete_task("a").get("ok")
+        dup = c.complete_task("a")
+        assert dup.get("ok") and dup.get("duplicate")
+        # requeued-but-unleased: lease dropped (fail_task), completion still
+        # lands — the worker only completes after a covering checkpoint
+        assert c.acquire_task() == "b"
+        c.fail_task("b")
+        back = c.complete_task("b")
+        assert back.get("ok") and back.get("requeued")
+        st = c.status()
+        assert int(st["done"]) == 2 and int(st["queued"]) == 0
+        # a task this run never heard of is still an error
+        assert not c.complete_task("never-added").get("ok")
+        c.close()
+
+
+@needs_native
+def test_acquire_req_id_dedup_returns_same_lease():
+    with CoordinatorServer() as server:
+        c = server.client("w0")
+        c.register()
+        c.add_tasks(["t0", "t1"])
+        first = c.call("acquire_task", req_id="lost-reply-1")
+        assert first["task"] == "t0"
+        retry = c.call("acquire_task", req_id="lost-reply-1")
+        assert retry["task"] == "t0" and retry.get("duplicate")
+        st = c.status()
+        assert int(st["leased"]) == 1, st  # no zombie second lease
+        fresh = c.call("acquire_task", req_id="lost-reply-2")
+        assert fresh["task"] == "t1"
+        c.close()
+
+
+@needs_native
+def test_kv_incr_op_id_dedup_survives_restart(tmp_path):
+    state = str(tmp_path / "state.jsonl")
+    server = CoordinatorServer(state_file=state, run_id="r1")
+    server.start()
+    try:
+        c = server.client("w0")
+        assert c.call("kv_incr", key="budget", delta=1,
+                      op_id="op-1")["value"] == 1
+        # same op replayed against the SAME incarnation: no double count
+        rep = c.call("kv_incr", key="budget", delta=1, op_id="op-1")
+        assert rep["value"] == 1 and rep.get("duplicate")
+        c.close()
+
+        server.kill()  # SIGKILL: only the journal survives
+        server.restart()
+        c = server.client("w0")
+        # replay across the restart: the marker was journaled with the value
+        rep = c.call("kv_incr", key="budget", delta=1, op_id="op-1")
+        assert rep["value"] == 1 and rep.get("duplicate")
+        assert c.call("kv_incr", key="budget", delta=1,
+                      op_id="op-2")["value"] == 2
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_outbox_replay_stops_on_transport_failure():
+    """A mid-replay outage keeps the tail buffered (nothing lost)."""
+
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def call(self, op, **fields):
+            self.calls += 1
+            if self.calls > 1:
+                raise CoordinatorUnreachable("mid-replay outage")
+            return {"ok": True}
+
+    ob = Outbox()
+    ob.add("complete_task", task="a")
+    ob.add("complete_task", task="b")
+    ob.add("kv_put", key="k", value="v")
+    flaky = Flaky()
+    assert ob.replay(flaky) == 1
+    assert len(ob) == 2
+    assert ob.pending()[0] == ("complete_task", {"task": "b"})
+
+
+def test_outbox_client_over_inprocess_coordinator():
+    """The facade composes with the in-process twin (same call surface)."""
+    coord = InProcessCoordinator(task_lease_sec=30.0)
+    c = OutboxClient(coord.client("w0"))
+    c.register()
+    c.add_tasks(["x"])
+    assert c.acquire_task() == "x"
+    assert c.complete_task("x").get("ok")
+    dup = c.complete_task("x")
+    assert dup.get("ok") and dup.get("duplicate")
+    assert c.summary()["outages"] == 0.0
+
+
+# -- supervision ---------------------------------------------------------------
+
+
+@needs_native
+def test_supervisor_restarts_killed_coordinator(tmp_path):
+    state = str(tmp_path / "state.jsonl")
+    server = CoordinatorServer(state_file=state, run_id="sup")
+    server.start()
+    sup = CoordinatorSupervisor(server, poll_interval=0.05)
+    sup.start()
+    try:
+        c = server.client("seed")
+        c.add_tasks(["t0", "t1"])
+        epoch_before = int(c.status()["epoch"])
+        c.close()
+
+        server.kill()
+        deadline = time.monotonic() + 20.0
+        revived = {}
+        while time.monotonic() < deadline:
+            try:
+                probe = server.client("probe")
+                revived = probe.status()
+                probe.close()
+                if revived.get("ok"):
+                    break
+            except Exception:  # edl: noqa[EDL005] probe loop: any transport error just means "not yet back"
+                pass
+            time.sleep(0.1)
+        assert revived.get("ok"), "supervisor never brought the coordinator back"
+        # journal resumed (queue intact), epoch bumped by the restart
+        assert int(revived["queued"]) == 2
+        assert int(revived["epoch"]) > epoch_before
+        # the counter increments on the watch thread AFTER the server is
+        # observably back (like k8s status lag) — poll, don't snapshot
+        while sup.summary()["restarts"] < 1.0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sup.summary()["restarts"] >= 1.0
+    finally:
+        sup.stop()
+
+
+def test_process_cluster_restarts_failed_coordinator_role():
+    from edl_tpu.api.quantity import ResourceList
+    from edl_tpu.controller.cluster import NodeInfo
+    from edl_tpu.controller.process_cluster import ProcessCluster
+
+    class W:
+        entrypoint = f"{sys.executable} -c 'import time; time.sleep(600)'"
+        env = {}
+        workspace = ""
+
+    cluster = ProcessCluster(
+        [NodeInfo(name="n0", allocatable=ResourceList.make({"cpu": 8}))])
+    try:
+        one_cpu = ResourceList.make({"cpu": 1})
+        cluster.create_role("job", "coordinator", 1, one_cpu, one_cpu, W())
+        pods = [p for p in cluster.pods if p.info.role == "coordinator"]
+        assert len(pods) == 1 and pods[0].info.phase == "Running"
+        cluster.kill_pod(pods[0].info.name)
+        deadline = time.monotonic() + 10.0
+        while (cluster.job_pods("job", "coordinator")[0].phase != "Failed"
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert cluster.job_pods("job", "coordinator")[0].phase == "Failed"
+        assert cluster.restart_failed("job", role="coordinator") == 1
+        replacement = cluster.job_pods("job", "coordinator")
+        assert len(replacement) == 1 and replacement[0].phase == "Running"
+        assert replacement[0].name != pods[0].info.name
+    finally:
+        cluster.shutdown()
+
+
+# -- end-to-end: elastic worker rides real outages -----------------------------
+
+
+def _counting_source(model, batch_size=8, batches_per_shard=4):
+    from edl_tpu.runtime.data import SyntheticShardSource
+
+    counts = {}
+
+    class Counting(SyntheticShardSource):
+        def read(self, shard):
+            counts[shard] = counts.get(shard, 0) + 1
+            return super().read(shard)
+
+    return Counting(model, batch_size=batch_size,
+                    batches_per_shard=batches_per_shard), counts
+
+
+@needs_native
+def test_elastic_worker_rides_5s_partition_exactly_once(tmp_path):
+    """The seeded-partition acceptance case: a 5 s network partition mid-run
+    neither kills the worker nor loses/duplicates a shard — every shard
+    trains exactly once, the lease ledger balances, and the outage shows up
+    in the worker's telemetry."""
+    import jax
+
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime.data import shard_names
+    from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+    from edl_tpu.runtime.train_loop import TrainerConfig
+
+    model = fit_a_line.MODEL
+    shards = shard_names("px", 5)
+    # Leases and membership must outlive the 5 s partition: TTLs at 60 s so
+    # the only thing the outage interrupts is bookkeeping.
+    with CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks(shards)
+
+        with ChaosProxy(server.port, seed=42) as proxy:
+            raw = CoordinatorClient(port=proxy.port, worker="w0",
+                                    retry=RetryPolicy(deadline=2.0, seed=42))
+            source, counts = _counting_source(model)
+            cfg = ElasticConfig(
+                checkpoint_dir=str(tmp_path / "ck"),
+                checkpoint_interval=4,          # ~one shard per commit
+                heartbeat_interval=0.0,         # poll the epoch every batch
+                outage_budget=60.0,
+                trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+            )
+            worker = ElasticWorker(model, raw, source, cfg,
+                                   device_planner=lambda w: jax.devices())
+
+            def chaos():
+                while worker.steps_done < 3 and not done_flag.is_set():
+                    time.sleep(0.02)
+                proxy.partition()
+                time.sleep(5.0)
+                proxy.heal()
+
+            done_flag = threading.Event()
+            t = threading.Thread(target=chaos, daemon=True)
+            t.start()
+            try:
+                metrics = worker.run()
+            finally:
+                done_flag.set()
+                t.join(timeout=10)
+
+        st = admin.status()
+        admin.close()
+    # ledger balanced: nothing lost, nothing leaked
+    assert int(st["done"]) == len(shards)
+    assert int(st["queued"]) == 0 and int(st["leased"]) == 0
+    # exactly once: no shard read twice (leases outlived the partition)
+    assert counts == {s: 1 for s in shards}, counts
+    # the outage actually happened and was ridden out, not rescaled through
+    assert metrics["outage_outages"] >= 1.0, metrics
+    assert metrics["rescales"] == 0.0, metrics
+
+
+@needs_native
+def test_elastic_worker_survives_coordinator_kill_and_restart(tmp_path):
+    """The SIGKILL acceptance case: the coordinator dies mid-run and comes
+    back (same state file, same run_id). The worker rides the outage on its
+    retry policy, adopts the restarted coordinator's bumped epoch without a
+    spurious rescale, replays buffered completions, and the job converges
+    with every shard trained exactly once."""
+    import jax
+
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime.data import shard_names
+    from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+    from edl_tpu.runtime.train_loop import TrainerConfig
+
+    model = fit_a_line.MODEL
+    shards = shard_names("kx", 5)
+    state = str(tmp_path / "coord-state.jsonl")
+    server = CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0,
+                               state_file=state, run_id="killrun")
+    server.start()
+    try:
+        admin = server.client("admin")
+        admin.add_tasks(shards)
+        admin.close()
+
+        raw = CoordinatorClient(port=server.port, worker="w0",
+                                retry=RetryPolicy(deadline=20.0, seed=3))
+        source, counts = _counting_source(model)
+        cfg = ElasticConfig(
+            checkpoint_dir=str(tmp_path / "ck"),
+            checkpoint_interval=4,
+            heartbeat_interval=0.0,
+            outage_budget=60.0,
+            trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+        )
+        worker = ElasticWorker(model, raw, source, cfg,
+                               device_planner=lambda w: jax.devices())
+
+        def chaos():
+            while worker.steps_done < 3 and not done_flag.is_set():
+                time.sleep(0.02)
+            server.kill()          # SIGKILL: no graceful anything
+            time.sleep(1.0)        # a real supervisor's restart latency
+            server.restart()
+
+        done_flag = threading.Event()
+        t = threading.Thread(target=chaos, daemon=True)
+        t.start()
+        try:
+            metrics = worker.run()
+        finally:
+            done_flag.set()
+            t.join(timeout=30)
+
+        probe = server.client("probe")
+        st = probe.status()
+        probe.close()
+    finally:
+        server.stop()
+    assert int(st["done"]) == len(shards), st
+    assert int(st["queued"]) == 0 and int(st["leased"]) == 0, st
+    # exactly once per shard: restored leases stayed with their holder
+    assert counts == {s: 1 for s in shards}, counts
+    assert metrics["steps"] == float(5 * 4), metrics
+
+
+# -- slow soak: sustained chaos + kill, multi-shard ----------------------------
+
+
+@pytest.mark.slow
+@needs_native
+def test_soak_sustained_chaos_with_coordinator_kill(tmp_path):
+    """Sustained seeded faults (delays + resets) AND a mid-run coordinator
+    SIGKILL+restart over a bigger queue. At-least-once is the floor (a reset
+    can kill a connection mid-acquire before the reply lands), exactly-once
+    is the expectation under lease preservation — assert the ledger and
+    that no shard trained more than twice (bounded replay)."""
+    import jax
+
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime.data import shard_names
+    from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+    from edl_tpu.runtime.train_loop import TrainerConfig
+
+    model = fit_a_line.MODEL
+    shards = shard_names("soak", 12)
+    state = str(tmp_path / "coord-state.jsonl")
+    server = CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0,
+                               state_file=state, run_id="soak")
+    server.start()
+    try:
+        admin = server.client("admin")
+        admin.add_tasks(shards)
+        admin.close()
+
+        with ChaosProxy(server.port, seed=99, delay_prob=0.2,
+                        delay_range=(0.005, 0.05), reset_prob=0.05) as proxy:
+            raw = CoordinatorClient(port=proxy.port, worker="w0",
+                                    retry=RetryPolicy(deadline=20.0, seed=99))
+            source, counts = _counting_source(model)
+            cfg = ElasticConfig(
+                checkpoint_dir=str(tmp_path / "ck"),
+                checkpoint_interval=4,
+                heartbeat_interval=0.0,
+                outage_budget=60.0,
+                trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+            )
+            worker = ElasticWorker(model, raw, source, cfg,
+                                   device_planner=lambda w: jax.devices())
+
+            def chaos():
+                while worker.steps_done < 6 and not done_flag.is_set():
+                    time.sleep(0.02)
+                server.kill()
+                time.sleep(1.5)
+                server.restart()
+
+            done_flag = threading.Event()
+            t = threading.Thread(target=chaos, daemon=True)
+            t.start()
+            try:
+                worker.run()
+            finally:
+                done_flag.set()
+                t.join(timeout=30)
+            assert proxy.stats["delays"] + proxy.stats["resets"] > 0
+
+        probe = server.client("probe")
+        st = probe.status()
+        probe.close()
+    finally:
+        server.stop()
+    assert int(st["done"]) == len(shards), st
+    assert int(st["queued"]) == 0 and int(st["leased"]) == 0, st
+    assert all(1 <= counts.get(s, 0) <= 2 for s in shards), counts
