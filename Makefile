@@ -3,13 +3,13 @@
 
 PYTHON ?= python
 
-.PHONY: analyze analyze-json baseline test chaos lint
+.PHONY: analyze analyze-json baseline test chaos lint bench-pipeline
 
 analyze:
-	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py
+	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py bench_pipeline.py
 
 analyze-json:
-	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py --format json
+	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py bench_pipeline.py --format json
 
 ## Regenerate accepted-debt baseline — only after consciously accepting or
 ## fixing findings; the diff IS the review artifact.
@@ -23,5 +23,10 @@ test:
 ## process-kill soaks tier-1 skips.
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
+
+## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
+## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).
+bench-pipeline:
+	$(PYTHON) bench_pipeline.py
 
 lint: analyze

@@ -37,7 +37,7 @@ def main() -> None:
 
     from bench import probe_or_exit
 
-    devices = probe_or_exit("flash_attention_speedup")
+    devices, init_attempts = probe_or_exit("flash_attention_speedup")
 
     from edl_tpu.ops import flash_attention
     from edl_tpu.parallel.ring_attention import dense_attention
@@ -84,7 +84,8 @@ def main() -> None:
         k = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.bfloat16)
         record = {"metric": "flash_attention_speedup",
-                  "shape_BSHD": [B, S, H, D], "steps": steps}
+                  "shape_BSHD": [B, S, H, D], "steps": steps,
+                  "init_attempts": init_attempts}
         try:
             run_flash = arm(lambda q, k, v: flash_attention(q, k, v), q, k, v)
         except Exception as e:  # noqa: BLE001 — report, don't crash the sweep

@@ -36,7 +36,9 @@ def main() -> None:
 
     from bench import median_of_best, probe_or_exit
 
-    devices = probe_or_exit("lm_train_tokens_per_sec_per_chip", "tokens/s/chip")
+    devices, init_attempts = probe_or_exit(
+        "lm_train_tokens_per_sec_per_chip", "tokens/s/chip"
+    )
     n_chips = len(devices)
 
     from edl_tpu.models.transformer import TransformerConfig, make_model
@@ -132,6 +134,7 @@ def main() -> None:
         "windows_tokens_per_sec_per_chip": [round(t / n_chips, 1) for t in fl],
         "windows_dense_arm": [round(t / n_chips, 1) for t in dn],
         "paired_ratios": [round(r, 3) for r in ratios],
+        "init_attempts": init_attempts,
         **accounting,
         "pairing": (
             "vs_baseline = median per-pair flash/dense ratio of interleaved "

@@ -13,10 +13,13 @@ axis the parallel layer supports, together and composably:
   column-sharded, output/down projections row-sharded, one `psum` after each
   (two per block), heads split across the axis.
 - ``pipe``  — pipeline parallelism: the block stack's leading layer dim is
-  sharded over the axis (each rank holds n_layers/pp contiguous blocks) and
-  executed with the GPipe microbatch schedule
-  (`edl_tpu.parallel.pipeline._pipeline_local`), composing with ring
-  attention and the TP psums inside each stage.
+  sharded over the axis and executed with one of three microbatch schedules
+  (GPipe via `edl_tpu.parallel.pipeline._pipeline_local`; plain or
+  interleaved 1F1B via `pipeline_train_1f1b` — with ``virtual_stages > 1``
+  each rank holds v NONCONTIGUOUS chunks of blocks, packed chunk-major by
+  `interleaved_layout` at init), composing with ring attention and the TP
+  psums inside each stage. MoE's load-balance aux loss rides every
+  schedule (per-stage accumulation, psum over the pipe axis).
 
 The whole forward/loss is ONE `shard_map` kernel, manual over the mesh: every
 matmul below is written against local shards, so the collectives are explicit
@@ -44,7 +47,11 @@ from edl_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from edl_tpu.models.base import Model
-from edl_tpu.parallel.pipeline import _pipeline_local, pipeline_train_1f1b
+from edl_tpu.parallel.pipeline import (
+    _pipeline_local,
+    interleaved_layout,
+    pipeline_train_1f1b,
+)
 from edl_tpu.parallel.ring_attention import _ring_attention_local
 from edl_tpu.parallel.sharding import present_axes
 
@@ -67,9 +74,17 @@ class TransformerConfig:
     #: microbatches for the pipeline schedule; None = stage count.
     microbatches: Optional[int] = None
     #: "gpipe" (default: autodiff through the forward schedule, O(M)
-    #: activation stash) or "1f1b" (combined fwd/bwd scan, O(pp) stash —
-    #: see edl_tpu.parallel.pipeline docstring for the schedule economics).
+    #: activation stash), "1f1b" (combined fwd/bwd scan, O(pp) stash), or
+    #: "1f1b-interleaved" (combined scan over ``virtual_stages`` chunks per
+    #: rank — bubble shrinks ~v-fold at fixed microbatches; see the
+    #: edl_tpu.parallel.pipeline docstring and the committed
+    #: BENCH_PIPELINE.json sweep for the measured economics).
     pipeline_schedule: str = "gpipe"
+    #: virtual stage chunks per pipe rank, >1 only with
+    #: pipeline_schedule="1f1b-interleaved". Requires n_layers divisible by
+    #: pp * virtual_stages and microbatches divisible by pp. Block storage
+    #: is then packed chunk-major (interleaved_layout) at init.
+    virtual_stages: int = 1
     #: per-block rematerialization (`jax.checkpoint` around each block under
     #: the scan): the backward pass recomputes block activations instead of
     #: storing them, cutting live activation memory from O(n_layers) to O(1)
@@ -99,10 +114,13 @@ class TransformerConfig:
     expert_axis: str = "expert"
     #: switch load-balance auxiliary loss weight (Shazeer/Fedus form:
     #: E * sum_e f_e * p_e per layer, f = routed-token fraction, p = mean
-    #: router prob). 0 = off. Supported on non-pipelined meshes (the aux
-    #: scalar threads through the block scan's carry; threading it through
-    #: the pipeline hop buffers is future work — a nonzero weight with a
-    #: pipe axis raises rather than silently training a different loss).
+    #: router prob). 0 = off. Works on every mesh, pipelined or not: under
+    #: a pipe axis each stage accumulates its layers' aux over its real
+    #: (stage, microbatch) executions, the schedules psum it over the pipe
+    #: axis and fold the microbatch-mean into the loss. Note the pipelined
+    #: form averages PER-MICROBATCH aux (routing fractions computed over
+    #: batch/microbatches tokens) — statistically the same balance pressure
+    #: as the whole-batch form, not bit-identical.
     moe_aux_weight: float = 0.0
 
     @property
@@ -178,16 +196,36 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
             f"seq_len={cfg.seq_len} must be divisible by "
             f"sp={_axis_size(mesh, cfg.seq_axis)}"
         )
-    if cfg.n_layers % _axis_size(mesh, cfg.pp_axis):
+    n_pp = _axis_size(mesh, cfg.pp_axis)
+    if cfg.n_layers % n_pp:
         raise ValueError(
-            f"n_layers={cfg.n_layers} must be divisible by "
-            f"pp={_axis_size(mesh, cfg.pp_axis)}"
+            f"n_layers={cfg.n_layers} must be divisible by pp={n_pp}"
         )
-    if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+    if cfg.pipeline_schedule not in ("gpipe", "1f1b", "1f1b-interleaved"):
         raise ValueError(
             f"unknown pipeline_schedule {cfg.pipeline_schedule!r}; "
-            "expected 'gpipe' or '1f1b'"
+            "expected 'gpipe', '1f1b' or '1f1b-interleaved'"
         )
+    v = cfg.virtual_stages
+    if v < 1:
+        raise ValueError(f"virtual_stages={v} must be >= 1")
+    if v > 1 and cfg.pipeline_schedule != "1f1b-interleaved":
+        raise ValueError(
+            f"virtual_stages={v} requires pipeline_schedule="
+            f"'1f1b-interleaved', got {cfg.pipeline_schedule!r}"
+        )
+    if cfg.pipeline_schedule == "1f1b-interleaved" and n_pp > 1:
+        if cfg.n_layers % (n_pp * v):
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must be divisible by "
+                f"pp*virtual_stages={n_pp * v} for the interleaved schedule"
+            )
+        if v > 1 and (cfg.microbatches or n_pp) % n_pp:
+            raise ValueError(
+                f"microbatches={cfg.microbatches} must be divisible by "
+                f"pp={n_pp} for the interleaved schedule (microbatches are "
+                f"injected in groups of pp)"
+            )
     E = cfg.moe_experts
     if E > 0 and E % _axis_size(mesh, cfg.expert_axis):
         raise ValueError(
@@ -197,12 +235,6 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
     if E > 0 and not 1 <= cfg.moe_top_k <= E:
         raise ValueError(
             f"moe_top_k={cfg.moe_top_k} must be in [1, moe_experts={E}]"
-        )
-    if cfg.moe_aux_weight > 0 and _axis_size(mesh, cfg.pp_axis) > 1:
-        raise ValueError(
-            "moe_aux_weight > 0 is not supported with a pipe axis (the aux "
-            "scalar does not thread through the pipeline hop buffers); "
-            "train MoE on data x expert x model meshes or set it to 0"
         )
     D, H, Dh, F, L, V = (
         cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_layers,
@@ -238,6 +270,15 @@ def _init(cfg: TransformerConfig, key: jax.Array, mesh: Mesh) -> dict:
             * math.sqrt(1.0 / F),
             "bout": jnp.zeros((L, D), jnp.float32),
         })
+    if cfg.pipeline_schedule == "1f1b-interleaved" and v > 1 and n_pp > 1:
+        # Chunk-major storage for the interleaved schedule: the row held at
+        # storage position p is logical layer perm[p], so rank r's P(pipe)
+        # shard carries its v noncontiguous chunks back to back. The
+        # permutation depends on this mesh's pp — checkpoints restored onto
+        # a mesh with a different pp (or schedule) need re-permuting, the
+        # same caveat contiguous stage sharding already has.
+        perm = interleaved_layout(L, n_pp, v)
+        blocks = jax.tree_util.tree_map(lambda a: a[perm], blocks)
     host = {
         "embed": jax.random.normal(ks[0], (V, D), jnp.float32) * 0.02,
         "pos": jax.random.normal(ks[1], (cfg.seq_len, D), jnp.float32) * 0.02,
@@ -404,10 +445,9 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
         block_fn = jax.checkpoint(block_fn, prevent_cse=False)
 
     def stage(blocks_local, h):
-        """Apply this rank's chunk of blocks — activation-only form for the
-        pipeline schedules (hop buffers carry activations; the per-block
-        aux scalar is dropped, which _init guards by rejecting a nonzero
-        moe_aux_weight on pipelined meshes)."""
+        """Apply this rank's chunk of blocks — activation-only form (the
+        per-block aux scalar is dropped; the schedules use stage_with_aux
+        when a nonzero moe_aux_weight needs it carried)."""
         h, _ = jax.lax.scan(
             lambda c, bp: (block_fn(c, bp)[0], None),
             h,
@@ -416,8 +456,15 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
         return h
 
     def stage_with_aux(blocks_local, h):
-        """Whole-stack form: accumulates the MoE load-balance aux through
-        the scan carry alongside the activations."""
+        """Aux-carrying form: accumulates the MoE load-balance aux through
+        the scan carry alongside the activations. Doubles as the pipeline
+        stage function under moe_aux_weight > 0 — the schedules accumulate
+        the returned per-stage value across real (stage, microbatch)
+        executions and psum it over the pipe axis. The accumulator is
+        shape (1,), not scalar: jax 0.4's shard_map transpose assigns
+        residuals a leading-dim sharding, which a rank-0 residual cannot
+        carry (_SpecError) — any input-dependent scalar in a
+        differentiated scan carry trips it."""
 
         def body(carry, bp):
             h, aux_acc = carry
@@ -425,7 +472,7 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
             return (h, aux_acc + aux), None
 
         (h, aux), _ = jax.lax.scan(
-            body, (h, jnp.zeros((), jnp.float32)), blocks_local
+            body, (h, jnp.zeros((1,), jnp.float32)), blocks_local
         )
         return h, aux
 
@@ -438,17 +485,28 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
         return jnp.mean(lse - gold)
 
     n_pp = _axis_size(mesh, cfg.pp_axis)
-    if n_pp > 1 and cfg.pipeline_schedule == "1f1b":
+    use_aux = cfg.moe_experts > 0 and cfg.moe_aux_weight > 0
+    # per-LAYER weight: stages accumulate per-block aux sums, the no-pipe
+    # path sums over the whole stack — dividing by n_layers makes the term
+    # a per-layer mean under every composition.
+    aux_w = cfg.moe_aux_weight / cfg.n_layers if use_aux else 0.0
+    if n_pp > 1 and cfg.pipeline_schedule in ("1f1b", "1f1b-interleaved"):
         # Combined-schedule pipeline: per-microbatch tail loss inside the
         # scan (the seed cotangent must exist while later microbatches are
         # still in forward — that interleaving is what bounds the
-        # activation stash at O(pp); see parallel.pipeline).
+        # activation stash at O(pp * virtual_stages); see parallel.pipeline).
+        v_eff = (
+            cfg.virtual_stages
+            if cfg.pipeline_schedule == "1f1b-interleaved" else 1
+        )
         loss = pipeline_train_1f1b(
-            stage,
+            stage_with_aux if use_aux else stage,
             lambda tp, y, tgt: tail_loss(tp[0], tp[1], y, tgt),
             cfg.pp_axis,
             n_pp,
             cfg.microbatches or n_pp,
+            v_eff,
+            aux_w,
             params["blocks"],
             (params["lnf"], params["head"]),
             x,
@@ -456,19 +514,21 @@ def _kernel(cfg: TransformerConfig, mesh: Mesh, params: dict, tokens, targets):
         )
     else:
         if n_pp > 1:
-            x = _pipeline_local(
-                stage,
+            out = _pipeline_local(
+                stage_with_aux if use_aux else stage,
                 params["blocks"],
                 x,
                 pipe_axis=cfg.pp_axis,
                 n_stages=n_pp,
                 microbatches=cfg.microbatches or n_pp,
+                stage_aux=use_aux,
             )
+            x, aux = out if use_aux else (out, jnp.zeros((1,), jnp.float32))
         else:
             x, aux = stage_with_aux(params["blocks"], x)
         loss = tail_loss(params["lnf"], params["head"], x, targets)
-        if n_pp == 1 and cfg.moe_aux_weight > 0:
-            loss = loss + cfg.moe_aux_weight * aux / cfg.n_layers
+        if use_aux:
+            loss = loss + aux_w * aux[0]
     reduce_axes = (*present_axes(mesh, cfg.batch_axis),
                    *present_axes(mesh, cfg.seq_axis))
     return jax.lax.pmean(loss, reduce_axes) if reduce_axes else loss

@@ -40,7 +40,7 @@ def main() -> None:
 
     from bench import probe_or_exit
 
-    devices = probe_or_exit("flash_onchip_check")
+    devices, init_attempts = probe_or_exit("flash_onchip_check")
     backend = devices[0].platform
 
     from edl_tpu.ops import flash_attention
@@ -113,6 +113,7 @@ def main() -> None:
         "cases": len(results),
         "failed": n_fail,
         "ok": n_fail == 0,
+        "init_attempts": init_attempts,
         "results": results,
     }
     here = os.path.dirname(os.path.abspath(__file__))

@@ -44,7 +44,7 @@ def main() -> None:
 
     from bench import probe_or_exit
 
-    devices = probe_or_exit("flash_block_sweep")
+    devices, init_attempts = probe_or_exit("flash_block_sweep")
     backend = devices[0].platform
     if backend == "cpu" and os.environ.get("EDL_SWEEP_ALLOW_CPU") != "1":
         print(json.dumps({
@@ -127,6 +127,7 @@ def main() -> None:
         "configs_timed": sum(1 for r in records if "ms_per_step" in r),
         "configs_failed": sum(1 for r in records if "error" in r),
         "table_written": backend != "cpu",
+        "init_attempts": init_attempts,
     }))
 
 

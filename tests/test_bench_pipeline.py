@@ -1,0 +1,66 @@
+"""bench_pipeline.py harness: smoke the sweep in-process at tiny shapes.
+
+The committed BENCH_PIPELINE.json comes from the full `make bench-pipeline`
+sweep; these tests pin the harness contract (every schedule present,
+analytic fields populated, crossover summary well-formed) without paying
+for it — the fuller configuration is slow-marked out of tier-1.
+"""
+
+import json
+
+import pytest
+
+
+def _run_sweep(monkeypatch, tmp_path, ms, vs, layers):
+    import bench_pipeline
+
+    out = tmp_path / "BENCH_PIPELINE.json"
+    monkeypatch.setenv("EDL_BENCH_PLATFORM", "cpu")
+    monkeypatch.setenv("EDL_PIPE_OUT", str(out))
+    monkeypatch.setenv("EDL_PIPE_MS", json.dumps(ms))
+    monkeypatch.setenv("EDL_PIPE_VS", json.dumps(vs))
+    monkeypatch.setenv("EDL_PIPE_LAYERS", str(layers))
+    monkeypatch.setenv("EDL_PIPE_D_MODEL", "32")
+    monkeypatch.setenv("EDL_PIPE_D_FF", "64")
+    monkeypatch.setenv("EDL_PIPE_SEQ", "16")
+    monkeypatch.setenv("EDL_BENCH_WINDOWS", "1")
+    monkeypatch.setenv("EDL_BENCH_STEPS", "1")
+    summary = bench_pipeline.main()
+    assert out.exists()
+    assert json.loads(out.read_text())["metric"] == summary["metric"]
+    return summary
+
+
+def test_sweep_smoke(monkeypatch, tmp_path):
+    summary = _run_sweep(monkeypatch, tmp_path, ms=[4], vs=[2], layers=8)
+    recs = summary["records"]
+    assert {r["schedule"] for r in recs} == {
+        "gpipe", "1f1b", "1f1b-interleaved"
+    }
+    for r in recs:
+        assert r["step_ms"] > 0
+        assert 0 < r["bubble_fraction"] < 1
+        assert r["stash_slots"] > 0
+        assert r["stash_bytes_per_device"] > 0
+    # the acceptance invariant the committed artifact must also show:
+    # interleaved bubble strictly below plain 1f1b at equal M for v >= 2
+    f = next(r for r in recs if r["schedule"] == "1f1b")
+    il = next(r for r in recs if r["schedule"] == "1f1b-interleaved")
+    assert il["bubble_fraction"] < f["bubble_fraction"]
+    # gpipe stashes O(M), the combined schedules O(n*v)
+    g = next(r for r in recs if r["schedule"] == "gpipe")
+    assert f["stash_bytes_per_device"] <= g["stash_bytes_per_device"]
+    cross = summary["crossover"]["4"]
+    assert cross["fastest"] in {"gpipe", "1f1b", "1f1b-interleaved"}
+    assert cross["best_interleaved_vs_1f1b_step_ratio"] is not None
+
+
+@pytest.mark.slow
+def test_sweep_fuller_configuration(monkeypatch, tmp_path):
+    summary = _run_sweep(
+        monkeypatch, tmp_path, ms=[4, 8, 16], vs=[2, 4], layers=16
+    )
+    # 3 + 3 + 3*2 configurations
+    assert len(summary["records"]) == 12
+    for m in ("4", "8", "16"):
+        assert m in summary["crossover"]
