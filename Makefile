@@ -3,13 +3,15 @@
 
 PYTHON ?= python
 
-.PHONY: analyze analyze-json baseline test chaos lint bench-pipeline
+ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py
+
+.PHONY: analyze analyze-json baseline test chaos lint bench-pipeline bench-coord
 
 analyze:
-	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py bench_pipeline.py
+	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
 
 analyze-json:
-	$(PYTHON) -m edl_tpu.analysis edl_tpu bench.py bench_rescale.py bench_pipeline.py --format json
+	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE) --format json
 
 ## Regenerate accepted-debt baseline — only after consciously accepting or
 ## fixing findings; the diff IS the review artifact.
@@ -28,5 +30,10 @@ chaos:
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).
 bench-pipeline:
 	$(PYTHON) bench_pipeline.py
+
+## Coordinator control-plane load bench at 100/1k/10k simulated workers;
+## regenerates BENCH_COORD.json (doc/performance.md, control-plane section).
+bench-coord:
+	$(PYTHON) bench_coord.py
 
 lint: analyze

@@ -1,0 +1,364 @@
+"""Control-plane load bench: one coordinator under 100/1k/10k workers.
+
+Drives a REAL coordinator process (the native C++ binary, spawned via
+`edl_tpu.coordinator.server.CoordinatorServer`) with an event-driven
+client multiplexer: one nonblocking TCP socket per simulated worker,
+closed-loop (each worker keeps exactly one control-plane "beat" in
+flight), all multiplexed through one `selectors` loop — NO 10k threads.
+The emitted BENCH_COORD.json is the artifact behind the control-plane
+section of doc/performance.md.
+
+Arms (both run the same binary; the delta is protocol + poller):
+
+- ``before`` — the pre-batching protocol shape under the poll(2) event
+  loop (``EDL_COORD_FORCE_POLL=1``): each beat is THREE separate frames
+  — heartbeat, kv_put (the worker's routine publish), and a dedicated
+  ``status`` round-trip for epoch discovery (what ``client.epoch()``
+  used before replies carried the epoch). Note this still understates
+  the seed server: the per-worker lease index and the deadline-cached
+  expiry scan benefit both arms, so the measured gap is conservative.
+- ``after`` — the batched/coalesced protocol on epoll: ONE ``batch``
+  frame per beat carrying [heartbeat, kv_put]; epoch discovery rides
+  the epoch stamped on every reply, so the dedicated poll disappears.
+
+Reported per (arm, N): worker beats/sec, server ops/sec, beat-latency
+p50/p99 (ms), journal fsyncs/sec and ops-per-fsync (group-commit
+amortization — fsyncs/sec should stay ~flat as N grows), ops-per-turn,
+snapshot compactions, and server CPU-seconds per kop (from
+/proc/<pid>/stat). Single-core caveat: bench and server share the
+machine, so absolute throughput is a floor and CPU-seconds/op plus the
+BETWEEN-ARM ratios are the meaningful numbers.
+
+Env: EDL_COORD_NS ([100,1000,10000]), EDL_COORD_SECS (4.0 measured
+window), EDL_COORD_WARMUP (0.5), EDL_COORD_ARMS (["before","after"]),
+EDL_COORD_WAVE (128 — registration wave size, bounded by the server's
+listen backlog), EDL_COORD_OUT (output path). Writes BENCH_COORD.json
+next to this file and prints a one-line summary JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import resource
+import selectors
+import socket
+import statistics
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, str(default)))
+
+
+def _env_list(name: str, default: list) -> list:
+    val = json.loads(os.environ.get(name, "null"))
+    if val is None or val == []:
+        return default
+    return val if isinstance(val, list) else [val]
+
+
+def _frame(obj: dict) -> bytes:
+    return (json.dumps(obj, separators=(",", ":")) + "\n").encode()
+
+
+class Sim:
+    """One simulated worker: a socket plus its closed-loop beat state.
+
+    A beat is a SEQUENCE of request-response stages, because that is what
+    the client transport does: ``CoordinatorClient.call`` is strictly
+    sequential, so the pre-batching worker's heartbeat + kv_put + epoch
+    poll are three dependent round trips, not three pipelined frames.
+    The batched beat is one stage.
+    """
+
+    __slots__ = ("sock", "name", "out", "expect", "t_send", "stages",
+                 "stage", "beats", "raw", "capture")
+
+    def __init__(self, sock: socket.socket, name: str):
+        self.sock = sock
+        self.name = name
+        self.out = b""       # unflushed bytes of the current frame
+        self.expect = 0      # reply lines outstanding for the current stage
+        self.t_send = 0.0    # beat start (stage 0 send time)
+        self.stages = []     # [(frame bytes, reply lines), ...] per beat
+        self.stage = -1      # index of the stage in flight (-1 = idle)
+        self.beats = 0
+        self.raw = b""       # reply capture (registration validation only)
+        self.capture = False
+
+
+def _flush(sel: selectors.DefaultSelector, s: Sim) -> None:
+    """Send what we can; arm EVENT_WRITE only while bytes remain queued."""
+    while s.out:
+        try:
+            n = s.sock.send(s.out)
+        except (BlockingIOError, InterruptedError):
+            break
+        s.out = s.out[n:]
+    want = selectors.EVENT_READ | (selectors.EVENT_WRITE if s.out else 0)
+    if sel.get_key(s.sock).events != want:
+        sel.modify(s.sock, want, s)
+
+
+def _send_stage(sel: selectors.DefaultSelector, s: Sim, idx: int) -> None:
+    payload, nreplies = s.stages[idx]
+    s.stage = idx
+    s.out += payload
+    s.expect = nreplies
+    if idx == 0:
+        s.t_send = time.monotonic()
+    _flush(sel, s)
+
+
+def _handle(sel, key, mask, lats, reissue: bool) -> None:
+    s: Sim = key.data
+    if mask & selectors.EVENT_WRITE:
+        _flush(sel, s)
+    if mask & selectors.EVENT_READ:
+        try:
+            data = s.sock.recv(1 << 16)
+        except (BlockingIOError, InterruptedError):
+            return
+        if not data:
+            raise RuntimeError(f"coordinator closed connection to {s.name}")
+        if s.capture:
+            s.raw += data
+        k = data.count(b"\n")
+        if k and s.expect > 0:
+            s.expect -= k
+            if s.expect <= 0:
+                if s.stage + 1 < len(s.stages):
+                    _send_stage(sel, s, s.stage + 1)  # next round trip
+                else:
+                    s.beats += 1
+                    s.stage = -1
+                    if lats is not None:
+                        lats.append(time.monotonic() - s.t_send)
+                    if reissue:
+                        _send_stage(sel, s, 0)
+
+
+def _pump(sel, sims, seconds: float, lats=None) -> None:
+    """Closed-loop drive for ``seconds``: idle sims get their next beat."""
+    t_end = time.monotonic() + seconds
+    for s in sims:
+        if s.stage < 0:
+            _send_stage(sel, s, 0)
+    while True:
+        left = t_end - time.monotonic()
+        if left <= 0:
+            return
+        for key, mask in sel.select(timeout=min(0.05, left)):
+            _handle(sel, key, mask, lats, reissue=True)
+
+
+def _connect_and_register(sel, port: int, n: int, wave: int):
+    """Open + register ``n`` worker sockets in waves bounded by the server's
+    listen backlog, validating every register reply."""
+    sims = []
+    for base in range(0, n, wave):
+        batch = []
+        for i in range(base, min(base + wave, n)):
+            name = f"w{i:05d}"
+            sk = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+            sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sk.setblocking(False)
+            s = Sim(sk, name)
+            s.capture = True
+            sel.register(sk, selectors.EVENT_READ, s)
+            s.stages = [(_frame({"op": "register", "worker": name}), 1)]
+            _send_stage(sel, s, 0)
+            batch.append(s)
+        deadline = time.monotonic() + 60.0
+        while any(s.expect > 0 for s in batch):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"registration stalled at {len(sims)}")
+            for key, mask in sel.select(timeout=0.5):
+                _handle(sel, key, mask, None, reissue=False)
+        for s in batch:
+            if b'"ok":true' not in s.raw:
+                raise RuntimeError(f"register failed for {s.name}: {s.raw!r}")
+            s.raw = b""
+            s.capture = False
+        sims += batch
+    return sims
+
+
+def _server_cpu_seconds(pid: int) -> float:
+    with open(f"/proc/{pid}/stat") as fh:
+        parts = fh.read().rsplit(")", 1)[1].split()
+    return (int(parts[11]) + int(parts[12])) / os.sysconf("SC_CLK_TCK")
+
+
+def _counters(status: dict) -> dict:
+    keys = ("ops", "batch_frames", "batch_subops", "fsyncs", "snapshots",
+            "journal_records", "turns")
+    return {k: int(status.get(k, 0)) for k in keys}
+
+
+def _beat_stages(arm: str, name: str) -> list:
+    """Request-response stages of one control-plane beat."""
+    hb = {"op": "heartbeat", "worker": name}
+    kv = {"op": "kv_put", "worker": name, "key": f"bench/{name}", "value": "x"}
+    if arm == "before":
+        # Pre-batching shape: three DEPENDENT round trips (the sequential
+        # client transport), including the dedicated epoch poll that
+        # reply-stamping makes obsolete.
+        return [(_frame(hb), 1), (_frame(kv), 1),
+                (_frame({"op": "status"}), 1)]
+    return [(_frame({
+        "op": "batch", "worker": name,
+        "ops": [json.dumps(hb, separators=(",", ":")),
+                json.dumps(kv, separators=(",", ":"))],
+    }), 1)]
+
+
+def run_cell(arm: str, n: int, mode: str, secs: float, warmup: float,
+             wave: int, active: int, tmpdir: str) -> dict:
+    """One measured window.
+
+    ``mode="saturated"`` drives all N workers closed-loop — the ceiling
+    measurement: max sustainable ops/sec, group-commit amortization,
+    CPU per op. ``mode="duty"`` drives only ``active`` workers while the
+    other N-active stay REGISTERED BUT IDLE — the realistic regime (a
+    worker beats ~1/s and a beat lasts ~1ms, so <1% of a 10k fleet is
+    mid-RPC at any instant) and the one that exposes the poll(2) tax:
+    every turn scans all N descriptors to find the few ready ones.
+    """
+    from edl_tpu.coordinator.server import CoordinatorServer
+
+    if arm == "before":
+        os.environ["EDL_COORD_FORCE_POLL"] = "1"
+    else:
+        os.environ.pop("EDL_COORD_FORCE_POLL", None)
+    # Long TTL/lease: the bench measures steady-state RPC handling, not
+    # expiry churn (expiry behavior has its own tests).
+    server = CoordinatorServer(
+        task_lease_sec=600.0, heartbeat_ttl_sec=600.0, auth_token="",
+        state_file=os.path.join(tmpdir, f"{arm}-{n}-{mode}.state"))
+    server.start()
+    sel = selectors.DefaultSelector()
+    try:
+        ctl = server.client("bench-ctl")
+        sims = _connect_and_register(sel, server.port, n, wave)
+        if mode == "duty":
+            # Spread the active subset across the fd range so neither
+            # poller gets a locality gift.
+            stride = max(1, n // min(active, n))
+            sims = sims[::stride][:active]
+        for s in sims:
+            s.stages = _beat_stages(arm, s.name)
+        _pump(sel, sims, warmup)
+
+        pid = server._proc.pid
+        c0, cpu0 = _counters(ctl.status()), _server_cpu_seconds(pid)
+        lats: list = []
+        t0 = time.monotonic()
+        _pump(sel, sims, secs, lats)
+        dt = time.monotonic() - t0
+        c1, cpu1 = _counters(ctl.status()), _server_cpu_seconds(pid)
+        ctl.close()
+
+        d = {k: c1[k] - c0[k] for k in c0}
+        beats = len(lats)
+        lats_ms = sorted(x * 1000.0 for x in lats)
+        ops = d["ops"]
+        return {
+            "arm": arm, "n": n, "mode": mode,
+            "active_workers": len(sims), "seconds": round(dt, 3),
+            "poller": "poll" if arm == "before" else "epoll",
+            "beats": beats,
+            "beats_per_sec": round(beats / dt, 1),
+            "ops_per_sec": round(ops / dt, 1),
+            "p50_ms": round(statistics.median(lats_ms), 3) if lats_ms else None,
+            "p99_ms": round(lats_ms[max(0, int(len(lats_ms) * 0.99) - 1)], 3)
+            if lats_ms else None,
+            "fsyncs_per_sec": round(d["fsyncs"] / dt, 2),
+            "ops_per_fsync": round(ops / d["fsyncs"], 1) if d["fsyncs"] else None,
+            "ops_per_turn": round(ops / d["turns"], 2) if d["turns"] else None,
+            "batch_frames": d["batch_frames"],
+            "batch_subops": d["batch_subops"],
+            "journal_records": d["journal_records"],
+            "snapshots": d["snapshots"],
+            "server_cpu_sec": round(cpu1 - cpu0, 3),
+            "server_cpu_sec_per_kop": round((cpu1 - cpu0) / ops * 1000.0, 4)
+            if ops else None,
+        }
+    finally:
+        for key in list(sel.get_map().values()):
+            key.fileobj.close()
+        sel.close()
+        server.stop()
+        os.environ.pop("EDL_COORD_FORCE_POLL", None)
+
+
+def main() -> dict:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+
+    ns = [int(x) for x in _env_list("EDL_COORD_NS", [100, 1000, 10000])]
+    arms = _env_list("EDL_COORD_ARMS", ["before", "after"])
+    modes = _env_list("EDL_COORD_MODES", ["saturated", "duty"])
+    secs = _env_float("EDL_COORD_SECS", 4.0)
+    warmup = _env_float("EDL_COORD_WARMUP", 0.5)
+    wave = int(_env_float("EDL_COORD_WAVE", 128))
+    active = int(_env_float("EDL_COORD_ACTIVE", 64))
+
+    results = []
+    with tempfile.TemporaryDirectory(prefix="edl-bench-coord-") as tmpdir:
+        for n in ns:
+            for mode in modes:
+                for arm in arms:
+                    cell = run_cell(arm, n, mode, secs, warmup, wave,
+                                    active, tmpdir)
+                    print(json.dumps(cell))
+                    results.append(cell)
+
+    by = {(c["arm"], c["n"], c["mode"]): c for c in results}
+    crossover = []
+    for n in ns:
+        for mode in modes:
+            b = by.get(("before", n, mode))
+            a = by.get(("after", n, mode))
+            if not (b and a):
+                continue
+            crossover.append({
+                "n": n, "mode": mode,
+                "beats_speedup":
+                round(a["beats_per_sec"] / b["beats_per_sec"], 2)
+                if b["beats_per_sec"] else None,
+                "p99_ratio": round(b["p99_ms"] / a["p99_ms"], 2)
+                if b["p99_ms"] and a["p99_ms"] else None,
+                "cpu_per_kop_ratio":
+                round(b["server_cpu_sec_per_kop"]
+                      / a["server_cpu_sec_per_kop"], 2)
+                if b["server_cpu_sec_per_kop"] and a["server_cpu_sec_per_kop"]
+                else None,
+            })
+    out = {
+        "bench": "coordinator_control_plane",
+        "config": {"ns": ns, "arms": arms, "modes": modes, "seconds": secs,
+                   "warmup": warmup, "active_workers_duty": active,
+                   "cpus": os.cpu_count(),
+                   "note": "bench and server share the host; ratios between "
+                           "arms are the meaningful numbers. The before arm "
+                           "understates the seed server (lease index + tick "
+                           "cache benefit both arms)."},
+        "results": results,
+        "crossover": crossover,
+    }
+    path = os.environ.get("EDL_COORD_OUT", os.path.join(REPO, "BENCH_COORD.json"))
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps({"wrote": path, "crossover": crossover}))
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -72,21 +72,46 @@ class CoordinatorClient:
     deadline, then raise CoordinatorUnreachable. Pass ``retry=None`` for
     the legacy crash-on-first-error behavior (some tests want it). Auth
     errors and reply timeouts are never retried — see retry.py's taxonomy.
+
+    Control-plane batching (BENCH_COORD.json): ``call_batch()`` sends many
+    sub-ops in ONE frame with positional per-sub-op replies, and
+    ``piggyback_heartbeat > 0`` transparently rides a due heartbeat on
+    whatever call is going out anyway — one round-trip instead of two, and
+    the membership observation lands in ``last_membership`` for workers to
+    coalesce on. Every reply's epoch (the server stamps all of them) is
+    tracked in ``observed_epoch``, so epoch discovery no longer needs
+    dedicated ``status`` polls.
     """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 7164,
                  worker: str = "", connect_timeout: float = 10.0,
                  token: Optional[str] = None,
-                 retry: Optional[RetryPolicy] = DEFAULT_RETRY):
+                 retry: Optional[RetryPolicy] = DEFAULT_RETRY,
+                 piggyback_heartbeat: float = 0.0):
         self.host = host
         self.port = port
         self.worker = worker
+        self.connect_timeout = connect_timeout
         self.token = token if token is not None \
             else os.environ.get("EDL_COORD_TOKEN", "")
         self.retry = retry
+        #: seconds between piggybacked heartbeats; 0 disables. When due, an
+        #: eligible call() is wrapped in a batch frame with a leading
+        #: heartbeat — the worker stays live without a dedicated RPC.
+        self.piggyback_heartbeat = piggyback_heartbeat
         #: transport-level retry attempts performed over this client's
         #: lifetime (outage telemetry; workers surface it in summaries).
         self.retry_count = 0
+        #: latest epoch seen on ANY reply (every server reply carries it),
+        #: and the monotonic instant it was observed. Workers use this to
+        #: skip dedicated epoch polls (coalesced watch-style notification).
+        self.observed_epoch: Optional[int] = None
+        self.observed_epoch_at: float = 0.0
+        #: latest ok membership reply (rank/world/epoch) from a heartbeat /
+        #: register / piggybacked heartbeat, with its observation instant.
+        self.last_membership: Optional[Dict] = None
+        self.last_membership_at: float = 0.0
+        self._last_piggyback = 0.0
         self._sock: Optional[socket.socket] = None
         self._buf = b""
         #: per-client nonce namespaces dedup ids (req_id/op_id) so a fresh
@@ -145,13 +170,16 @@ class CoordinatorClient:
         are idempotent server-side (``complete_task``). Auth rejections
         and reply timeouts propagate immediately.
         """
+        if self._piggyback_due(op, fields):
+            return self._call_with_piggyback(op, timeout, fields)
         if self.retry is None:
             return self._call_once(op, timeout, fields)
         deadline = time.monotonic() + self.retry.deadline
         sleeps = self.retry.sleeps()
         while True:
             try:
-                return self._call_once(op, timeout, fields)
+                return self._call_once(op, timeout, fields,
+                                       connect_deadline=deadline)
             except (CoordinatorAuthError, CoordinatorTimeout):
                 raise
             except CoordinatorUnreachable:
@@ -161,8 +189,81 @@ class CoordinatorClient:
                 self.retry_count += 1  # edl: noqa[EDL001] telemetry counter; a torn increment under-counts a metric, never corrupts protocol state
                 time.sleep(delay)
 
+    def call_batch(self, ops: List, timeout: Optional[float] = None) -> List[Dict]:
+        """Send many sub-ops in ONE frame; returns per-sub-op replies.
+
+        ``ops`` is a list of ``(op, fields)`` pairs (or dicts carrying an
+        ``"op"`` key). The frame's worker identity and token cover every
+        sub-op; per-sub-op dedup (``req_id``/``op_id``) and idempotence
+        hold exactly as they do for single-op calls, so whole-frame retry
+        after a transport failure is as safe as retrying each op — which
+        is why the frame rides the same retry policy as ``call()``.
+        ``barrier``/``sync`` are not batchable (their replies are parked
+        server-side and cannot be threaded into a positional reply array).
+        """
+        encoded = []
+        for item in ops:
+            if isinstance(item, dict):
+                req = dict(item)
+            else:
+                op, fields = item
+                req = {"op": op, **fields}
+            encoded.append(json.dumps(req, ensure_ascii=False))
+        reply = self.call("batch", timeout=timeout, ops=encoded)
+        if not reply.get("ok"):
+            raise CoordinatorError(f"batch frame rejected: {reply.get('error')}")
+        subs = [json.loads(line) for line in reply.get("replies", [])]
+        for sub in subs:
+            self._note_reply(sub)
+        return subs
+
+    #: ops a due heartbeat may NOT ride on: frames/parked ops (reply shape),
+    #: and membership ops whose own semantics a heartbeat would perturb.
+    _NO_PIGGYBACK = frozenset({"batch", "barrier", "sync",
+                               "register", "leave", "heartbeat"})
+
+    def _piggyback_due(self, op: str, fields: Dict) -> bool:
+        return (self.piggyback_heartbeat > 0
+                and bool(self.worker)
+                and op not in self._NO_PIGGYBACK
+                and "worker" not in fields
+                and time.monotonic() - self._last_piggyback
+                >= self.piggyback_heartbeat)
+
+    def _call_with_piggyback(self, op: str, timeout: Optional[float],
+                             fields: Dict) -> Dict:
+        # Ride the due heartbeat on this call's frame: one round-trip keeps
+        # the worker live AND performs the op. The heartbeat sub-reply is
+        # absorbed into last_membership by call_batch's _note_reply; the
+        # caller sees only its own op's reply, same contract as call().
+        hb_reply, main = self.call_batch(
+            [("heartbeat", {}), (op, fields)], timeout=timeout)
+        if hb_reply.get("ok"):
+            self._last_piggyback = time.monotonic()  # edl: noqa[EDL001] telemetry timestamp; a torn write only re-piggybacks early
+        return main
+
+    def _note_reply(self, reply: Dict) -> None:
+        # Epoch observations are monotonic telemetry: GIL-atomic attribute
+        # writes, read opportunistically by workers — no lock needed.
+        if not isinstance(reply, dict):
+            return
+        ep = reply.get("epoch")
+        if ep is None:
+            return
+        try:
+            ep = int(ep)
+        except (TypeError, ValueError):
+            return
+        now = time.monotonic()
+        self.observed_epoch = ep  # edl: noqa[EDL001] coalesced-epoch telemetry; stale reads only cost one extra heartbeat RPC
+        self.observed_epoch_at = now  # edl: noqa[EDL001] coalesced-epoch telemetry; stale reads only cost one extra heartbeat RPC
+        if reply.get("ok") and "rank" in reply and "world" in reply:
+            self.last_membership = dict(reply)  # edl: noqa[EDL001] coalesced-epoch telemetry; stale reads only cost one extra heartbeat RPC
+            self.last_membership_at = now  # edl: noqa[EDL001] coalesced-epoch telemetry; stale reads only cost one extra heartbeat RPC
+
     def _call_once(self, op: str, timeout: Optional[float],
-                   fields: Dict) -> Dict:
+                   fields: Dict,
+                   connect_deadline: Optional[float] = None) -> Dict:
         # The lock intentionally spans the socket round-trip: this is a
         # CLIENT connection whose replies pair to requests by ordering, so
         # the transaction must be atomic per thread — unlike the
@@ -172,9 +273,17 @@ class CoordinatorClient:
             if self._sock is None:
                 # A previous timeout/error poisoned the connection (a late
                 # reply may still be in flight, which would desync
-                # request/reply pairing) — start a fresh one.
+                # request/reply pairing) — start a fresh one. The re-dial
+                # budget honors the CONFIGURED connect_timeout, clipped to
+                # what remains of the retry policy's deadline when call()
+                # is driving retries (a hard-coded 5.0 here used to both
+                # overshoot tight deadlines and undershoot generous ones).
                 self._buf = b""
-                self._connect(5.0)
+                budget = self.connect_timeout
+                if connect_deadline is not None:
+                    budget = min(budget,
+                                 max(0.1, connect_deadline - time.monotonic()))
+                self._connect(budget)
             req = {"op": op, **fields}
             if self.worker and "worker" not in req:
                 req["worker"] = self.worker
@@ -208,6 +317,7 @@ class CoordinatorClient:
             raise CoordinatorAuthError(
                 f"coordinator rejected {op!r}: {reply.get('error', 'unauthorized')}"
             )
+        self._note_reply(reply)
         return reply
 
     # -- membership ------------------------------------------------------------
@@ -230,6 +340,9 @@ class CoordinatorClient:
         return self.call("members")["members"]
 
     def epoch(self) -> int:
+        """Fresh epoch via a status round-trip. Hot paths should prefer
+        ``observed_epoch`` (stamped on every reply) and let epoch discovery
+        coalesce onto traffic that is happening anyway."""
         return int(self.call("status")["epoch"])
 
     def bump_epoch(self) -> int:

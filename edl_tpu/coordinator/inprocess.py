@@ -376,9 +376,29 @@ class InProcessClient:
         self._c = coord
         self.worker = worker
         self.token = token
+        #: coalesced-epoch surface, mirroring CoordinatorClient: workers
+        #: read these instead of issuing dedicated epoch polls. In-process
+        #: there is no wire to save, but the attributes keep worker code
+        #: backend-agnostic.
+        self.observed_epoch: Optional[int] = None
+        self.observed_epoch_at: float = 0.0
+        self.last_membership: Optional[Dict] = None
+        self.last_membership_at: float = 0.0
+        self.piggyback_heartbeat: float = 0.0
+        self.retry_count = 0
 
     def _auth(self) -> None:
         self._c.authorize(self.token)
+
+    def _note_reply(self, reply):
+        if isinstance(reply, dict) and "epoch" in reply:
+            now = time.monotonic()
+            self.observed_epoch = int(reply["epoch"])
+            self.observed_epoch_at = now
+            if reply.get("ok") and "rank" in reply and "world" in reply:
+                self.last_membership = dict(reply)
+                self.last_membership_at = now
+        return reply
 
     def close(self) -> None:
         pass
@@ -391,11 +411,11 @@ class InProcessClient:
 
     def register(self, takeover: bool = False):
         self._auth()
-        return self._c.register(self.worker, takeover=takeover)
+        return self._note_reply(self._c.register(self.worker, takeover=takeover))
 
     def heartbeat(self):
         self._auth()
-        return self._c.heartbeat(self.worker)
+        return self._note_reply(self._c.heartbeat(self.worker))
 
     def leave(self):
         self._auth()
@@ -473,7 +493,43 @@ class InProcessClient:
             value = self._c.kv_incr(fields["key"], fields.get("delta", 1),
                                     op_id=fields.get("op_id"))
             return {"ok": True, "value": value}
+        if op == "heartbeat":
+            return self._note_reply(self._c.heartbeat(self.worker))
+        if op == "kv_get":
+            return {"ok": True, "value": self._c.kv_get(fields["key"])}
+        if op == "kv_del":
+            self._c.kv_del(fields["key"])
+            return {"ok": True}
+        if op == "acquire_task":
+            return self._c.acquire(self.worker, req_id=fields.get("req_id"))
+        if op == "add_tasks":
+            return {"ok": True, "added": self._c.add_tasks(fields["tasks"])}
+        if op == "status":
+            return self._c.status()
+        if op == "ping":
+            return {"ok": True, "pong": True}
         raise ValueError(f"unsupported in-process op {op!r}")
+
+    def call_batch(self, ops, timeout=None):
+        """Batched-frame parity with CoordinatorClient.call_batch: the same
+        per-sub-op reply list, driven through the shim — so the outbox's
+        batched replay and worker piggyback paths run identically against
+        the hermetic twin. Sub-op semantics (dedup ids, idempotence) are
+        the coordinator's own; framing adds nothing in-process."""
+        replies = []
+        for item in ops:
+            if isinstance(item, dict):
+                fields = dict(item)
+                op = fields.pop("op", "")
+            else:
+                op, fields = item
+                fields = dict(fields)
+            if op in ("batch", "barrier", "sync"):
+                replies.append(
+                    {"ok": False, "error": f"op not batchable: {op}"})
+                continue
+            replies.append(self.call(op, timeout=timeout, **fields))
+        return replies
 
     def status(self):
         self._auth()

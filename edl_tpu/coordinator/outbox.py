@@ -64,19 +64,34 @@ class Outbox:
         with self._lock:
             return list(self._entries)
 
-    def replay(self, client) -> int:
-        """Replay buffered ops in order through ``client.call``.
+    #: sub-ops per replay frame: bounds frame size (a 10k-op outage backlog
+    #: must not serialize into one multi-megabyte line) while amortizing
+    #: the round-trip ~64x versus per-op replay.
+    BATCH = 64
 
-        Returns the number of ops drained. Stops (keeping the tail) on the
-        first transport failure so a mid-replay outage loses nothing; a
-        rejected op ({"ok": False}) is dropped — the server has already
-        resolved it (e.g. a fail_task whose lease expired and requeued).
-        One replayer at a time: a thread that finds a drain already in
-        flight returns 0 (its guarded call proceeds; ops are idempotent).
+    def replay(self, client) -> int:
+        """Replay buffered ops in order through the client.
+
+        Uses ``client.call_batch`` when the client has one — ordered frames
+        of up to :data:`BATCH` sub-ops, one round-trip each — and falls
+        back to per-op ``client.call``. Returns the number of ops drained.
+        Stops (keeping the tail) on the first transport failure so a
+        mid-replay outage loses nothing: a frame that failed in transit is
+        retried whole later, which is safe for the same reason replay is
+        safe at all — every buffered op is idempotent or deduped server-
+        side (op_id markers), even if the lost frame was partially applied.
+        A rejected sub-op ({"ok": False}) is dropped — the server has
+        already resolved it (e.g. a fail_task whose lease expired and
+        requeued). One replayer at a time: a thread that finds a drain
+        already in flight returns 0 (its guarded call proceeds; ops are
+        idempotent).
         """
         if not self._replaying.acquire(blocking=False):
             return 0
         try:
+            call_batch = getattr(client, "call_batch", None)
+            if call_batch is not None:
+                return self._replay_batched(call_batch)
             drained = 0
             while True:
                 with self._lock:
@@ -95,6 +110,24 @@ class Outbox:
             return drained
         finally:
             self._replaying.release()
+
+    def _replay_batched(self, call_batch) -> int:
+        drained = 0
+        while True:
+            with self._lock:
+                frame = list(self._entries[:self.BATCH])
+            if not frame:
+                break
+            try:
+                call_batch(frame)
+            except CoordinatorAuthError:
+                raise
+            except CoordinatorError:
+                break
+            with self._lock:
+                del self._entries[:len(frame)]
+            drained += len(frame)
+        return drained
 
 
 class OutboxClient:

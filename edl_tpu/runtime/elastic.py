@@ -24,6 +24,7 @@ mimicking trainers joining a slice.
 from __future__ import annotations
 
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence
@@ -47,6 +48,11 @@ class ElasticConfig:
     checkpoint_dir: str = ""
     checkpoint_interval: int = 100  # steps between periodic async saves
     heartbeat_interval: float = 1.0  # seconds between coordinator heartbeats
+    #: fractional jitter (±) applied per beat to the heartbeat interval,
+    #: seeded by worker name: 10k workers launched from one template would
+    #: otherwise phase-lock into synchronized heartbeat storms that turn
+    #: the coordinator's load spiky (see doc/performance.md, control plane).
+    heartbeat_jitter: float = 0.2
     #: max wait for survivors at the rescale barrier; on timeout we proceed
     #: (the checkpoint is already durable, latecomers restore from it).
     rescale_barrier_timeout: float = 60.0
@@ -101,6 +107,20 @@ def default_device_planner(chips_per_trainer: int) -> Callable[[int], Sequence[j
     return plan
 
 
+def heartbeat_schedule(worker: str, base: float, jitter: float,
+                       n: int) -> List[float]:
+    """First ``n`` heartbeat intervals for ``worker``: ``base`` ± ``jitter``
+    fraction, drawn from an RNG seeded by the worker's name. This is the
+    exact sequence ElasticWorker/MultiHostWorker sleep between beats —
+    deterministic per name (str seeds hash stably in ``random.Random``),
+    different across names, so a fleet de-correlates without coordination.
+    Exposed for tests and capacity planning.
+    """
+    rng = random.Random(f"edl-hb:{worker}")
+    return [max(0.0, base * (1.0 + jitter * (2.0 * rng.random() - 1.0)))
+            for _ in range(n)]
+
+
 @dataclass
 class RescaleEvent:
     at_step: int
@@ -148,6 +168,20 @@ class ElasticWorker:
         self._prev_world = 0
         self._rank = -1
         self._last_heartbeat = 0.0
+        #: per-worker seeded jitter stream (satellite of the control-plane
+        #: scale work): each beat draws its own interval so the fleet's
+        #: heartbeats de-correlate instead of arriving in phase-locked waves.
+        self._hb_rng = random.Random(f"edl-hb:{self.client.worker}")
+        self._hb_interval = self._next_hb_interval()
+        #: heartbeats satisfied from a piggybacked membership observation
+        #: (no dedicated RPC issued).
+        self.hb_coalesced = 0
+        # Piggyback heartbeats onto in-flight calls when the transport
+        # supports it: lease/kv traffic then refreshes our TTL for free and
+        # most dedicated beats coalesce away entirely.
+        raw = getattr(self.client, "client", self.client)
+        if getattr(raw, "piggyback_heartbeat", None) == 0.0:
+            raw.piggyback_heartbeat = config.heartbeat_interval
         #: True between observing the coordinator unreachable and the next
         #: successful control-plane call — gates benign epoch adoption.
         self._outage_open = False
@@ -204,7 +238,16 @@ class ElasticWorker:
                 logged = True
                 log.warning("parked: waiting for coordinator (%s)",
                             reply.get("error", "unreachable"))
-            time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+            # Jittered: a coordinator restart otherwise gets the whole
+            # parked fleet re-registering in phase-locked waves.
+            base = min(1.0, max(0.1, self.config.heartbeat_interval))
+            time.sleep(max(0.05, base * (1.0 + self.config.heartbeat_jitter
+                                         * (2.0 * self._hb_rng.random() - 1.0))))
+
+    def _next_hb_interval(self) -> float:
+        return max(0.0, self.config.heartbeat_interval
+                   * (1.0 + self.config.heartbeat_jitter
+                      * (2.0 * self._hb_rng.random() - 1.0)))
 
     def _epoch_changed(self, force: bool = False) -> bool:
         """Heartbeat (rate-limited) and report whether membership moved.
@@ -215,10 +258,23 @@ class ElasticWorker:
         the budget it reports True so run() checkpoints durably and parks.
         """
         now = time.monotonic()
-        if not force and now - self._last_heartbeat < self.config.heartbeat_interval:
+        if not force and now - self._last_heartbeat < self._hb_interval:
             return False
         self._last_heartbeat = now
-        reply = self.client.heartbeat()
+        self._hb_interval = self._next_hb_interval()
+        # Coalesce: every coordinator reply carries the current epoch, and
+        # membership-shaped replies (piggybacked heartbeats among them) are
+        # recorded by the transport. A fresh observation — made within the
+        # nominal interval, so the server-side TTL was refreshed then too —
+        # answers this beat without a dedicated RPC.
+        lm = getattr(self.client, "last_membership", None)
+        lm_at = getattr(self.client, "last_membership_at", 0.0)
+        if (not force and lm is not None
+                and now - lm_at < self.config.heartbeat_interval):
+            reply = dict(lm)
+            self.hb_coalesced += 1
+        else:
+            reply = self.client.heartbeat()
         if reply.get("unreachable"):
             self._outage_open = True
             outage = self.client.outage_seconds()

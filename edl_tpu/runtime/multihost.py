@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import time
 from typing import Dict, List, Optional
 
@@ -117,8 +118,51 @@ class MultiHostWorker:
         #: delete a plan a straggler still needs (the round-plan GC race).
         self._plan_rounds: List[int] = []
         self._collective_hwm: int = -1
+        #: seeded per-worker jitter stream: heartbeat/backoff cadence draws
+        #: from it so a gang of 10k processes sharing one config template
+        #: de-correlates instead of hammering the coordinator in phase
+        #: (same scheme as ElasticWorker — see elastic.heartbeat_schedule).
+        self._hb_rng = random.Random(f"edl-hb:{self.client.worker}")
+        self._next_hb = 0.0
+        #: heartbeats satisfied from a piggybacked membership observation.
+        self.hb_coalesced = 0
+        raw = getattr(self.client, "client", self.client)
+        if getattr(raw, "piggyback_heartbeat", None) == 0.0:
+            raw.piggyback_heartbeat = config.heartbeat_interval
 
     # -- plumbing --------------------------------------------------------------
+
+    def _jittered(self, base: float) -> float:
+        """``base`` ± config.heartbeat_jitter fraction, from the seeded
+        per-worker stream."""
+        j = getattr(self.config, "heartbeat_jitter", 0.0)
+        return max(0.0, base * (1.0 + j * (2.0 * self._hb_rng.random() - 1.0)))
+
+    def _hb_sleep(self) -> None:
+        """Outage/backoff pause at heartbeat cadence, jittered so retry
+        storms from a whole gang spread out instead of arriving in waves."""
+        time.sleep(self._jittered(
+            min(1.0, max(0.1, self.config.heartbeat_interval))))
+
+    def _maybe_heartbeat(self) -> None:
+        """Beat at the jittered heartbeat interval — not per poll iteration.
+
+        The poll loop spins at 20 Hz per rank; heartbeating every spin is
+        what melts the control plane at 10k workers. TTL refresh needs one
+        beat per ``heartbeat_interval``, and with reply piggybacking on the
+        kv_get polls even that usually coalesces away (the transport records
+        the membership observation; we just consume it).
+        """
+        now = time.monotonic()
+        if now < self._next_hb:
+            return
+        self._next_hb = now + self._jittered(self.config.heartbeat_interval)
+        lm = getattr(self.client, "last_membership", None)
+        lm_at = getattr(self.client, "last_membership_at", 0.0)
+        if lm is not None and now - lm_at < self.config.heartbeat_interval:
+            self.hb_coalesced += 1
+            return
+        self.client.heartbeat()  # fails soft under OutboxClient
 
     def _build_mesh(self) -> Mesh:
         devices = jax.devices()  # global: every process's chips
@@ -175,7 +219,7 @@ class MultiHostWorker:
                     "gang restart", self.client.outage_seconds(),
                     self.config.outage_budget)
                 return {"stop": "rescale"}
-            time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+            self._hb_sleep()
             hb = self.client.heartbeat()
         if not hb.get("ok"):
             hb = self.client.register()
@@ -281,7 +325,7 @@ class MultiHostWorker:
                         "round %d: coordinator outage exceeded budget %.1fs; "
                         "assuming rescale", rnd, self.config.outage_budget)
                     return {"stop": "rescale"}
-                time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+                self._hb_sleep()
                 continue
             if down_since is not None:
                 down_since = None
@@ -290,7 +334,7 @@ class MultiHostWorker:
                 return json.loads(raw)
             if time.monotonic() >= deadline:
                 break
-            self.client.heartbeat()  # fails soft under OutboxClient
+            self._maybe_heartbeat()
             time.sleep(0.05)
         log.warning("round %d plan never arrived; assuming rescale", rnd)
         return {"stop": "rescale"}
@@ -395,7 +439,7 @@ class MultiHostWorker:
             if not info.get("unreachable") or (
                     self.client.outage_seconds() > self.config.outage_budget):
                 self._exit_for_restart()
-            time.sleep(min(1.0, max(0.1, self.config.heartbeat_interval)))
+            self._hb_sleep()
             info = self.client.register(takeover=True)
         epoch = int(info["epoch"])
 

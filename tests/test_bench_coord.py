@@ -1,0 +1,300 @@
+"""Batched control-plane protocol + bench_coord harness.
+
+The exactly-once contract (PR 3's req_id/op_id dedup, outbox replay,
+journal durability) must survive the batching/coalescing rework — a
+batch frame is transport framing, not new semantics. These tests pin
+that, plus the epoch stamping / heartbeat piggybacking the workers'
+coalesced epoch discovery rides on, the seeded heartbeat jitter, and
+the bench harness contract (slow-marked 1k-worker smoke).
+"""
+
+import json
+import time
+
+import pytest
+
+from edl_tpu.coordinator import (
+    CoordinatorServer,
+    InProcessCoordinator,
+    OutboxClient,
+    RetryPolicy,
+)
+from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
+from edl_tpu.runtime.elastic import heartbeat_schedule
+from edl_tpu.testing import ChaosProxy
+
+from tests.test_coordinator import has_toolchain
+
+needs_native = pytest.mark.skipif(
+    not has_toolchain(), reason="native toolchain unavailable"
+)
+
+
+# -- batch framing: exactly-once preserved -------------------------------------
+
+
+@needs_native
+def test_batch_roundtrip_and_subop_dedup_inside_frame():
+    """Two acquire sub-ops with the SAME req_id in ONE frame: the dedup
+    cache resolves the second to the first's lease — the lost-reply retry
+    contract holds even when the retry rides the same batch."""
+    with CoordinatorServer() as server:
+        c = server.client("w0")
+        c.register()
+        c.add_tasks(["t0", "t1"])
+        first, retry, fresh = c.call_batch([
+            ("acquire_task", {"req_id": "r-1"}),
+            ("acquire_task", {"req_id": "r-1"}),
+            ("acquire_task", {"req_id": "r-2"}),
+        ])
+        assert first["task"] == "t0"
+        assert retry["task"] == "t0" and retry.get("duplicate")
+        assert fresh["task"] == "t1"
+        assert int(c.status()["leased"]) == 2  # no zombie third lease
+        c.close()
+
+
+@needs_native
+def test_batch_subops_inherit_frame_worker_and_reject_unbatchable():
+    with CoordinatorServer() as server:
+        c = server.client("w0")
+        c.register()
+        # heartbeat sub-op without an explicit worker inherits the frame's
+        hb, bad = c.call_batch([
+            ("heartbeat", {}),
+            ("barrier", {"key": "b", "count": 1}),
+        ])
+        assert hb.get("ok")
+        assert not bad.get("ok") and "not batchable" in bad.get("error", "")
+        c.close()
+
+
+@needs_native
+def test_batch_replies_carry_epoch_and_update_observed():
+    with CoordinatorServer() as server:
+        c = server.client("w0")
+        c.register()
+        e0 = c.observed_epoch
+        assert e0 is not None
+        c.bump_epoch()
+        hb, = c.call_batch([("heartbeat", {})])
+        assert int(hb["epoch"]) == e0 + 1
+        assert c.observed_epoch == e0 + 1
+        assert c.last_membership is not None \
+            and int(c.last_membership["world"]) == 1
+        c.close()
+
+
+def test_inprocess_call_batch_parity():
+    coord = InProcessCoordinator()
+    c = coord.client("w0")
+    c.register()
+    c.add_tasks(["t0"])
+    hb, got, bad = c.call_batch([
+        ("heartbeat", {}),
+        ("acquire_task", {"req_id": "r"}),
+        ("barrier", {"key": "b", "count": 1}),
+    ])
+    assert hb.get("ok")
+    assert got["task"] == "t0"
+    assert not bad.get("ok") and "not batchable" in bad.get("error", "")
+    assert c.observed_epoch is not None
+
+
+@pytest.mark.chaos
+@needs_native
+def test_batched_outbox_replay_across_kill_and_restart(tmp_path):
+    """Mutations buffered through a partition + coordinator SIGKILL replay
+    as batch frames after restart and land exactly once."""
+    state = str(tmp_path / "state.jsonl")
+    server = CoordinatorServer(state_file=state, run_id="r1",
+                               task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    server.start()
+    try:
+        with ChaosProxy(server.port, seed=3) as proxy:
+            raw = CoordinatorClient(port=proxy.port, worker="w0",
+                                    retry=RetryPolicy(deadline=1.0, seed=1))
+            c = OutboxClient(raw)
+            c.register()
+            c.add_tasks(["s0"])
+            assert c.acquire_task() == "s0"
+            # one durable op_id'd increment BEFORE the partition: its replay
+            # after the restart must dedup against the journaled marker
+            assert c.call("kv_incr", key="ctr", delta=1,
+                          op_id="op-pre")["value"] == 1
+
+            proxy.partition()
+            assert c.complete_task("s0").get("buffered")
+            c.kv_put("during", "x")
+            c.outbox.add("kv_incr", key="ctr", delta=1, op_id="op-pre")
+            c.outbox.add("kv_incr", key="ctr", delta=1, op_id="op-out")
+            assert len(c.outbox) == 4
+
+            server.kill()  # SIGKILL: only the journal survives
+            server.restart()
+            proxy.heal()
+
+            deadline = time.monotonic() + 20.0
+            while len(c.outbox) and time.monotonic() < deadline:
+                c.heartbeat()
+                time.sleep(0.05)
+            assert len(c.outbox) == 0
+
+            st = c.status()
+            # the replay went through the batch path, not op-by-op
+            assert int(st["batch_frames"]) >= 1
+            assert int(st["done"]) == 1  # completion applied once
+            assert c.kv_get("during") == "x"
+            # op-pre deduped against the restart-surviving marker; op-out
+            # applied exactly once
+            assert c.kv_get("ctr") == "2"
+            rep = c.call("kv_incr", key="ctr", delta=1, op_id="op-out")
+            assert rep["value"] == 2 and rep.get("duplicate")
+            raw.close()
+    finally:
+        server.stop()
+
+
+@pytest.mark.chaos
+@needs_native
+def test_snapshot_compaction_under_batched_load_survives_kill(tmp_path):
+    """Enough batched mutations to cross the compaction threshold, then
+    SIGKILL: the compacted snapshot + tail journal restore full state."""
+    state = str(tmp_path / "state.jsonl")
+    server = CoordinatorServer(state_file=state, run_id="r1")
+    server.start()
+    try:
+        c = server.client("w0")
+        c.register()
+        snaps = 0
+        for i in range(40):  # 40 frames x 64 kv_puts > 1024-record threshold
+            frame = [("kv_put", {"key": f"k{j % 128}", "value": f"v{i}"})
+                     for j in range(64)]
+            for rep in c.call_batch(frame):
+                assert rep.get("ok")
+            snaps = int(c.status()["snapshots"])
+            if snaps >= 1 and i >= 20:
+                break
+        assert snaps >= 1, "compaction never triggered"
+        records = int(c.status()["journal_records"])
+        assert records >= 1024  # monotonic lifetime counter, not reset by
+        c.close()               # compaction
+
+        server.kill()
+        server.restart()
+        c = server.client("w0")
+        assert c.kv_get("k0") is not None  # state survived the compaction
+        assert int(c.status()["epoch"]) >= 1
+        c.close()
+    finally:
+        server.stop()
+
+
+# -- heartbeat piggybacking ----------------------------------------------------
+
+
+@needs_native
+def test_piggyback_heartbeat_wraps_calls_into_batches():
+    with CoordinatorServer(heartbeat_ttl_sec=60.0) as server:
+        c = CoordinatorClient(port=server.port, worker="w0",
+                              piggyback_heartbeat=0.01)
+        c.register()
+        time.sleep(0.02)
+        c.kv_put("a", "1")  # eligible call: rides a batch with a heartbeat
+        st = c.status()
+        assert int(st["batch_frames"]) >= 1
+        assert int(st["batch_subops"]) >= 2
+        assert c.last_membership is not None
+        assert c.kv_get("a") == "1"  # the wrapped op still applied
+        c.close()
+
+
+# -- heartbeat jitter ----------------------------------------------------------
+
+
+def test_heartbeat_jitter_decorrelates_workers():
+    a = heartbeat_schedule("w0", base=1.0, jitter=0.2, n=64)
+    b = heartbeat_schedule("w1", base=1.0, jitter=0.2, n=64)
+    # deterministic per worker (stable across processes: str seeding)
+    assert a == heartbeat_schedule("w0", base=1.0, jitter=0.2, n=64)
+    # different workers draw different schedules
+    assert a != b
+    # bounded: every interval within +/- 20% of base
+    for x in a + b:
+        assert 0.8 <= x <= 1.2
+    # de-correlation: beat TIMES drift apart, so the fleet cannot stay
+    # phase-locked — the max pairwise phase offset grows past any fixed
+    # sync window as beats accumulate
+    ta = tb = 0.0
+    offsets = []
+    for xa, xb in zip(a, b):
+        ta += xa
+        tb += xb
+        offsets.append(abs(ta - tb))
+    assert max(offsets) > 0.25
+    # zero jitter degenerates to the fixed interval (storms return)
+    flat = heartbeat_schedule("w0", base=1.0, jitter=0.0, n=8)
+    assert flat == [1.0] * 8
+
+
+def test_worker_heartbeats_coalesce_onto_piggybacked_observations():
+    """An ElasticWorker-style beat consumes a fresh piggybacked membership
+    observation instead of issuing a dedicated RPC (InProcess twin)."""
+    coord = InProcessCoordinator()
+    c = coord.client("w0")
+    c.register()
+    assert c.last_membership is not None
+    before = c.last_membership_at
+    # a membership-shaped reply refreshes the observation
+    c.heartbeat()
+    assert c.last_membership_at >= before
+
+
+# -- bench harness -------------------------------------------------------------
+
+
+@needs_native
+def test_bench_cell_contract(monkeypatch, tmp_path):
+    """Tiny in-process run of one bench cell per arm: counters move, the
+    latency fields populate, and the before arm really runs on poll."""
+    import bench_coord
+
+    before = bench_coord.run_cell("before", 16, "saturated", 0.4, 0.1,
+                                  16, 8, str(tmp_path))
+    after = bench_coord.run_cell("after", 16, "saturated", 0.4, 0.1,
+                                 16, 8, str(tmp_path))
+    for cell in (before, after):
+        assert cell["beats"] > 0
+        assert cell["ops_per_sec"] > 0
+        assert cell["p99_ms"] is not None and cell["p99_ms"] > 0
+        assert cell["server_cpu_sec"] >= 0
+    assert before["poller"] == "poll" and before["batch_frames"] == 0
+    assert after["poller"] == "epoll" and after["batch_frames"] > 0
+    assert after["batch_subops"] == 2 * after["batch_frames"]
+
+
+@pytest.mark.slow
+@needs_native
+def test_bench_coord_smoke_1k(monkeypatch, tmp_path):
+    """1k simulated workers end to end through main(): both arms, duty
+    mode, artifact written with the crossover summary."""
+    import bench_coord
+
+    out = tmp_path / "BENCH_COORD.json"
+    monkeypatch.setenv("EDL_COORD_NS", "[1000]")
+    monkeypatch.setenv("EDL_COORD_MODES", '["duty"]')
+    monkeypatch.setenv("EDL_COORD_SECS", "1.0")
+    monkeypatch.setenv("EDL_COORD_WARMUP", "0.2")
+    monkeypatch.setenv("EDL_COORD_ACTIVE", "32")
+    monkeypatch.setenv("EDL_COORD_OUT", str(out))
+    summary = bench_coord.main()
+    assert out.exists()
+    disk = json.loads(out.read_text())
+    assert disk["results"] == summary["results"]
+    assert {c["arm"] for c in summary["results"]} == {"before", "after"}
+    for cell in summary["results"]:
+        assert cell["n"] == 1000 and cell["active_workers"] == 32
+        assert cell["beats"] > 0 and cell["p99_ms"] > 0
+    (cross,) = summary["crossover"]
+    assert cross["n"] == 1000
+    assert cross["beats_speedup"] > 0 and cross["p99_ratio"] > 0
