@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py
+ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py
 
-.PHONY: analyze analyze-json baseline test chaos lint bench-pipeline bench-coord
+.PHONY: analyze analyze-json baseline test chaos lint bench-pipeline bench-coord bench-collective
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -35,5 +35,11 @@ bench-pipeline:
 ## regenerates BENCH_COORD.json (doc/performance.md, control-plane section).
 bench-coord:
 	$(PYTHON) bench_coord.py
+
+## Data-plane collective arms (implicit psum / explicit reduce-scatter /
+## bucketed-overlap accumulation) on flat + hierarchical meshes;
+## regenerates BENCH_COLLECTIVE.json (doc/performance.md, data-plane section).
+bench-collective:
+	$(PYTHON) bench_collective.py
 
 lint: analyze

@@ -404,6 +404,19 @@ def main() -> None:
         "paired_ratios": [round(r, 4) for r in pipe_ratios],
     }
 
+    # Analytic data-plane accounting for the measured configuration
+    # (Trainer.data_plane): gradient bytes-on-wire per step and the
+    # bandwidth-model collective estimate, next to the measured rates —
+    # the same closed form bench_collective.py sweeps across grad_sync
+    # modes and mesh hierarchies.
+    plane = wire_arm["trainer"].data_plane(wire_arm["state"].params)
+    data_plane = {
+        "grad_sync": plane["grad_sync"],
+        "grad_bytes_per_step": plane["grad_bytes_per_step"],
+        "bytes_per_step": plane["bytes_per_step"],
+        "collective_ms_est": round(plane["collective_seconds"] * 1e3, 4),
+    }
+
     from edl_tpu.tools.mfu import mfu_fields
 
     accounting = mfu_fields(
@@ -451,6 +464,7 @@ def main() -> None:
                 ],
                 "paired_ratios": [round(r, 4) for r in ratios],
                 "pipelined": pipelined,
+                "data_plane": data_plane,
                 "median_of_best": keep,
                 "init_attempts": init_attempts,
                 **accounting,

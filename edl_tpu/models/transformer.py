@@ -96,7 +96,11 @@ class TransformerConfig:
     #: Serves BOTH attention paths — the unsharded-sequence case directly,
     #: and the seq-sharded ring as its per-hop block engine (hops merge
     #: associatively in (out, lse) form, gradients flow through the
-    #: kernel's differentiable lse). Interpret mode on CPU.
+    #: kernel's differentiable lse). Interpret mode on CPU. The kernel
+    #: blocks over the batch dim, so changing the per-call batch (e.g.
+    #: `grad_accum_microbatches` slicing) reassociates the softmax/grad
+    #: accumulation order — bit-exact single-step-vs-accumulated
+    #: comparisons need `flash=False` (see tests/test_collective.py).
     flash: bool = True
     #: mixture-of-experts FFN: >0 replaces every block's dense FFN with
     #: `moe_experts` switch-routed (top-1) experts whose weights shard over

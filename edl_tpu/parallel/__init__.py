@@ -15,6 +15,13 @@ data plane (`paddle pserver` C++, sparse port pools `pkg/jobparser.go:232-247`,
   sharding annotations: same train step, any mesh.
 """
 
+from edl_tpu.parallel.collective import (
+    assign_buckets,
+    collective_bytes,
+    ring_bytes,
+    zero1_step_bytes,
+    zero_shard_spec,
+)
 from edl_tpu.parallel.mesh import (
     MeshSpec, build_hierarchical_mesh, build_mesh, local_mesh,
 )
@@ -31,14 +38,19 @@ from edl_tpu.parallel.ring_attention import dense_attention, ring_attention
 __all__ = [
     "MeshSpec",
     "ShardedEmbedding",
+    "assign_buckets",
     "batch_sharding",
     "build_hierarchical_mesh",
     "build_mesh",
+    "collective_bytes",
     "dense_attention",
     "local_mesh",
     "named_sharding",
     "pipeline_apply",
     "replicate",
     "ring_attention",
+    "ring_bytes",
     "shard_batch",
+    "zero1_step_bytes",
+    "zero_shard_spec",
 ]

@@ -1,0 +1,351 @@
+"""Explicit data-plane collectives: ZeRO-1 shard placement, gradient
+buckets, and the closed-form bytes-on-wire cost model.
+
+The implicit data plane (seed behavior) leaves gradient exchange entirely
+to XLA: the loss's ``pmean`` over the batch axis becomes a full-gradient
+all-reduce, and the ZeRO-1 moment sharding drags an all-gather of the
+updated params behind it. That program moves ``3·P·(N−1)/N`` bytes per
+chip per step (all-reduce 2P + all-gather P). The explicit plane this
+module supports restructures the step as
+
+    reduce-scatter(grads) → sharded optimizer update → all-gather(params)
+
+which moves ``2·P·(N−1)/N`` — the all-reduce's reduce phase is fused with
+the shard the optimizer actually needs, so the gather half of the
+all-reduce is never paid. On a hierarchical ``("dcn", "data")`` mesh the
+same structure keeps the cross-slice hop at shard size (``P/k`` over DCN
+instead of P). `collective_bytes` is the closed form for all of it,
+validated leaf-by-leaf in tests and committed per-arm by
+``bench_collective.py`` — the honest-accounting convention of
+``bench_pipeline.py`` applied to the data plane.
+
+Nothing here opens a channel or calls a collective directly: the
+"issuance" primitive inside jit-SPMD is `jax.lax.with_sharding_constraint`
+— pinning a gradient to its ZeRO shard layout is what makes the
+partitioner lower the cross-batch-axis reduction as reduce-scatter
+instead of all-reduce. Buckets group those constraints so the async
+collective scheduler has bounded-size transfers to overlap with the
+backward pass of the next microbatch (`Trainer` grad-accumulation mode).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from edl_tpu.parallel.sharding import BatchAxis, batch_shardings, present_axes
+
+__all__ = [
+    "GradBucket",
+    "assign_buckets",
+    "collective_bytes",
+    "constrain_to_specs",
+    "estimate_collective_seconds",
+    "ring_bytes",
+    "split_microbatches",
+    "zero1_step_bytes",
+    "zero_shard_dim",
+    "zero_shard_spec",
+]
+
+#: default per-chip interconnect bandwidths (bytes/sec) for the
+#: ``collective_ms`` estimate series. TPU-v4-generation ballpark: ~1e11 B/s
+#: of ICI bandwidth per chip, ~2.5e10 B/s per chip across the data-center
+#: network. Estimates, not measurements — override via
+#: ``estimate_collective_seconds(..., ici_bps=, dcn_bps=)`` (the profiler
+#: series exists to expose the bytes-vs-time structure, not to predict a
+#: specific fabric).
+ICI_BYTES_PER_SEC = 1.0e11
+DCN_BYTES_PER_SEC = 2.5e10
+
+
+# -- ZeRO shard placement ------------------------------------------------------
+
+
+def zero_shard_dim(shape: Sequence[int], n: int) -> Optional[int]:
+    """The dim a ZeRO-1 shard splits: the LARGEST dim divisible by ``n``
+    (ties broken toward dim 0). Largest-first keeps the per-chip shards
+    contiguous runs of the biggest axis — balanced and DMA-friendly —
+    where first-divisible would happily split a size-8 leading dim of a
+    (8, 4096) tensor into 1-row slivers. None when nothing divides (the
+    leaf stays replicated) or there is nothing to split (n <= 1)."""
+    if n <= 1:
+        return None
+    best: Optional[int] = None
+    for dim, size in enumerate(shape):
+        if size > 0 and size % n == 0:
+            if best is None or size > shape[best]:
+                best = dim
+    return best
+
+
+def zero_shard_spec(
+    shape: Sequence[int], mesh: Mesh, axis: BatchAxis
+) -> Optional[P]:
+    """PartitionSpec placing a replicated leaf's ZeRO-1 shard over the
+    batch axis (or axis hierarchy): ``zero_shard_dim`` carries the present
+    axes, every other dim replicated. None when the mesh has no batch axis
+    or no dim divides."""
+    axes = present_axes(mesh, axis)
+    if not axes:
+        return None
+    n = 1
+    for a in axes:
+        n *= mesh.shape[a]
+    dim = zero_shard_dim(shape, n)
+    if dim is None:
+        return None
+    spec: List[Any] = [None] * len(shape)
+    spec[dim] = axes if len(axes) > 1 else axes[0]
+    return P(*spec)
+
+
+def constrain_to_specs(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """Apply `with_sharding_constraint` per leaf; a ``None`` spec leaves
+    that leaf unconstrained (non-ZeRO leaves keep whatever layout the
+    partitioner chose). ``specs`` mirrors ``tree`` with Optional[P] leaves."""
+    return jax.tree_util.tree_map(
+        lambda x, s: x if s is None else jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, s)
+        ),
+        tree,
+        specs,
+        is_leaf=lambda x: x is None or isinstance(x, P),
+    )
+
+
+# -- gradient buckets ----------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class GradBucket:
+    """A contiguous group of gradient leaves reduced as one unit.
+
+    ``indices`` are flat-leaf positions in ``tree_leaves`` order; ``nbytes``
+    is the group's full (unsharded) gradient payload. Buckets bound the
+    size of each issued reduction so the first reductions can start before
+    the whole backward finishes — the DDP/ZeRO overlap granularity.
+    """
+
+    indices: Tuple[int, ...]
+    nbytes: int
+
+
+def assign_buckets(
+    leaf_nbytes: Sequence[int], bucket_bytes: int
+) -> List[GradBucket]:
+    """Greedy contiguous packing of gradient leaves into ~``bucket_bytes``
+    buckets, walking leaves in REVERSE traversal order — backward produces
+    the LAST parameters' gradients first, so reverse packing lets the
+    first-completed bucket be the first reduction issued. A leaf larger
+    than ``bucket_bytes`` gets a bucket of its own (never split: the
+    reduction unit is a whole leaf). Returned in issue order (reverse of
+    tree order); every leaf appears in exactly one bucket."""
+    if bucket_bytes <= 0:
+        raise ValueError(f"bucket_bytes must be > 0, got {bucket_bytes}")
+    buckets: List[GradBucket] = []
+    pending: List[int] = []
+    pending_bytes = 0
+    for idx in reversed(range(len(leaf_nbytes))):
+        nb = int(leaf_nbytes[idx])
+        if pending and pending_bytes + nb > bucket_bytes:
+            buckets.append(GradBucket(tuple(pending), pending_bytes))
+            pending, pending_bytes = [], 0
+        pending.append(idx)
+        pending_bytes += nb
+    if pending:
+        buckets.append(GradBucket(tuple(pending), pending_bytes))
+    return buckets
+
+
+# -- closed-form bytes on wire -------------------------------------------------
+
+
+def ring_bytes(nbytes: float, n: int, op: str) -> float:
+    """Per-chip bytes-on-wire of one ring collective over ``n`` chips on a
+    buffer whose FULL (unsharded) size is ``nbytes``:
+
+    - ``reduce_scatter`` / ``all_gather``: each chip sends (n−1) shards of
+      nbytes/n — ``nbytes·(n−1)/n``.
+    - ``all_reduce``: reduce-scatter + all-gather back to back —
+      ``2·nbytes·(n−1)/n``.
+
+    These are the bandwidth-optimal algorithm counts (ring or equivalently
+    bidirectional torus per-link totals); latency terms are out of scope.
+    """
+    if n <= 1:
+        return 0.0
+    frac = (n - 1) / n
+    if op == "all_reduce":
+        return 2.0 * nbytes * frac
+    if op in ("reduce_scatter", "all_gather"):
+        return nbytes * frac
+    raise ValueError(f"unknown collective op {op!r}")
+
+
+def collective_bytes(
+    nbytes: float, tiers: Sequence[Tuple[str, int]], op: str
+) -> Dict[str, float]:
+    """Per-chip bytes-on-wire of a (possibly hierarchical) collective.
+
+    ``tiers`` lists (name, size) outermost → innermost, matching the mesh
+    axis tuple — e.g. ``[("dcn", 2), ("data", 4)]``. A single tier is the
+    flat ring (`ring_bytes`). With multiple tiers the standard hierarchical
+    lowering is priced, innermost (fastest fabric) first:
+
+    - ``all_reduce``: inner reduce-scatter at full size, outer all-reduce
+      on the 1/k shard, inner all-gather — the intra-slice RS / inter-slice
+      AR / intra-slice AG structure XLA emits for a psum over
+      ``("dcn", "data")``.
+    - ``reduce_scatter``: inner RS at full size, then outer RS on the
+      shard — each tier only ever moves the data that still needs crossing
+      it.
+    - ``all_gather``: the exact reverse — outer AG assembles the
+      slice-level shard, inner AG replicates it.
+
+    Returns {tier name: bytes, "total": bytes}. Degenerate tiers (size 1)
+    contribute 0. The recursion peels the innermost tier, so >2 tiers work,
+    though nothing in the codebase builds them today.
+    """
+    tiers = [(name, int(size)) for name, size in tiers]
+    out: Dict[str, float] = {name: 0.0 for name, _ in tiers}
+    if op not in ("all_reduce", "reduce_scatter", "all_gather"):
+        raise ValueError(f"unknown collective op {op!r}")
+
+    def _recurse(nbytes: float, tiers: Sequence[Tuple[str, int]], op: str):
+        if not tiers:
+            return
+        if len(tiers) == 1:
+            name, n = tiers[0]
+            out[name] += ring_bytes(nbytes, n, op)
+            return
+        outer, (inner_name, k) = tiers[:-1], tiers[-1]
+        if op == "all_reduce":
+            out[inner_name] += ring_bytes(nbytes, k, "reduce_scatter")
+            _recurse(nbytes / max(k, 1), outer, "all_reduce")
+            out[inner_name] += ring_bytes(nbytes, k, "all_gather")
+        elif op == "reduce_scatter":
+            out[inner_name] += ring_bytes(nbytes, k, "reduce_scatter")
+            _recurse(nbytes / max(k, 1), outer, "reduce_scatter")
+        else:  # all_gather: outer assembles shard, inner replicates
+            _recurse(nbytes / max(k, 1), outer, "all_gather")
+            out[inner_name] += ring_bytes(nbytes, k, "all_gather")
+
+    _recurse(float(nbytes), tiers, op)
+    out["total"] = sum(out[name] for name, _ in tiers)
+    return out
+
+
+def zero1_step_bytes(
+    sharded_bytes: float,
+    replicated_bytes: float,
+    tiers: Sequence[Tuple[str, int]],
+    grad_sync: str,
+) -> Dict[str, float]:
+    """Analytic per-chip bytes-on-wire of ONE train step's data-plane
+    collectives under ZeRO-1 moment sharding.
+
+    ``sharded_bytes`` — total gradient/param bytes of the leaves that carry
+    a ZeRO shard layout (a divisible dim exists); ``replicated_bytes`` —
+    leaves that stay replicated (their gradient is all-reduced either way).
+
+    - ``psum`` (implicit): all_reduce(all grads) + all_gather(sharded
+      params) — the gather is the price of the moment sharding: each chip
+      only computes its shard of the update, the full params must
+      reassemble.
+    - ``reduce_scatter`` (explicit): reduce_scatter(sharded grads) +
+      all_reduce(replicated grads) + all_gather(sharded params). The
+      sharded fraction's sync drops from 3 units to 2.
+
+    Returns per-tier bytes plus {"grad_bytes", "param_bytes", "total"}.
+    The strict inequality RS < psum (whenever sharded_bytes > 0 and some
+    tier has size > 1) is the acceptance invariant BENCH_COLLECTIVE.json
+    commits and tests assert.
+    """
+    if grad_sync not in ("psum", "reduce_scatter"):
+        raise ValueError(f"unknown grad_sync {grad_sync!r}")
+    per_tier: Dict[str, float] = {name: 0.0 for name, _ in tiers}
+
+    def _add(acct: Dict[str, float]) -> float:
+        for name, _ in tiers:
+            per_tier[name] += acct[name]
+        return acct["total"]
+
+    grad = _add(collective_bytes(replicated_bytes, tiers, "all_reduce"))
+    if grad_sync == "psum":
+        grad += _add(collective_bytes(sharded_bytes, tiers, "all_reduce"))
+    else:
+        grad += _add(collective_bytes(sharded_bytes, tiers, "reduce_scatter"))
+    param = _add(collective_bytes(sharded_bytes, tiers, "all_gather"))
+    return {
+        **per_tier,
+        "grad_bytes": grad,
+        "param_bytes": param,
+        "total": grad + param,
+    }
+
+
+def estimate_collective_seconds(
+    per_tier_bytes: Dict[str, float],
+    ici_bps: float = ICI_BYTES_PER_SEC,
+    dcn_bps: float = DCN_BYTES_PER_SEC,
+) -> float:
+    """Bandwidth-model time estimate for per-tier byte counts: the ``dcn``
+    tier moves at DCN speed, every other tier at ICI speed, tiers summed
+    (hierarchical phases are sequential). An ESTIMATE for observability
+    (the profiler's ``collective_ms`` series), not a measurement."""
+    seconds = 0.0
+    for name, nbytes in per_tier_bytes.items():
+        if name in ("total", "grad_bytes", "param_bytes"):
+            continue
+        seconds += nbytes / (dcn_bps if name == "dcn" else ici_bps)
+    return seconds
+
+
+# -- microbatch split (gradient accumulation) ----------------------------------
+
+
+def split_microbatches(
+    batch: Dict[str, jax.Array],
+    n_micro: int,
+    mesh: Mesh,
+    axis: BatchAxis,
+    specs: Optional[Any] = None,
+) -> Dict[str, jax.Array]:
+    """Reshape every batch leaf (B, ...) → (n_micro, B/n_micro, ...) for a
+    `lax.scan` over microbatches, pushing each leaf's batch sharding from
+    dim 0 to dim 1 (the microbatch dim is the scan carrier and must be
+    replicated). With ``specs`` (the model's `batch_spec` pytree) each
+    leaf's own layout shifts right; without, the default leading-dim batch
+    sharding does. Requires every leaf's dim 0 divisible by ``n_micro``.
+
+    Which samples land in which microbatch is a partition choice with no
+    effect on the ACCUMULATED gradient — every sample appears exactly once
+    and the final gradient is the mean over all of them (reassociated
+    floating-point, same as any reduction-order change).
+    """
+    if n_micro <= 1:
+        raise ValueError(f"n_micro must be > 1, got {n_micro}")
+    shardings = batch_shardings(mesh, axis, specs)
+    per_leaf = not isinstance(shardings, jax.sharding.Sharding)
+
+    def _split(x: jax.Array, sharding) -> jax.Array:
+        b = x.shape[0]
+        if b % n_micro:
+            raise ValueError(
+                f"batch dim {b} not divisible by microbatches {n_micro}"
+            )
+        y = x.reshape((n_micro, b // n_micro) + tuple(x.shape[1:]))
+        spec = sharding.spec if hasattr(sharding, "spec") else P()
+        return jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, *spec))
+        )
+
+    if per_leaf:
+        return jax.tree_util.tree_map(_split, dict(batch), shardings)
+    return jax.tree_util.tree_map(lambda x: _split(x, shardings), dict(batch))

@@ -40,7 +40,10 @@ def batch_sharding(mesh: Mesh, axis: BatchAxis = "data") -> NamedSharding:
     (absent axes drop out; none present: replicate)."""
     have = present_axes(mesh, axis)
     if have:
-        return NamedSharding(mesh, P(have))  # P accepts a 1-tuple entry
+        # a single axis stays a bare name: P(("data",)) and P("data") shard
+        # identically but compare unequal, and the normalized form is what
+        # every other spec in the codebase (and tests) uses
+        return NamedSharding(mesh, P(have if len(have) > 1 else have[0]))
     return replicate(mesh)
 
 

@@ -82,6 +82,40 @@ class TrainerConfig:
     #: layouts are untouched, so the math is identical. Already-sharded
     #: moments (e.g. row-sharded embedding tables') keep their sharding.
     shard_opt_state: bool = False
+    #: gradient synchronization over the batch axis:
+    #: - "psum" — implicit: XLA all-reduces the FULL gradient (2·P bytes/chip
+    #:   on a ring) and, under shard_opt_state, all-gathers the updated
+    #:   params behind it (3·P·(N−1)/N total).
+    #: - "reduce_scatter" — explicit ZeRO-1 data plane: gradients are pinned
+    #:   to their ZeRO shard layout BEFORE the optimizer update, so the
+    #:   cross-batch-axis reduction lowers as reduce-scatter, each chip
+    #:   updates its 1/N moment+gradient shard, and only the updated params
+    #:   all-gather (2·P·(N−1)/N total — the all-reduce's gather half is
+    #:   never paid). Requires shard_opt_state and a model param_spec; on a
+    #:   ("dcn", "data") hierarchy the DCN hop stays at shard size. Exact
+    #:   same math (elementwise update on shards; reduction reassociation
+    #:   is the only float-level difference). See parallel.collective for
+    #:   the closed-form byte accounting and BENCH_COLLECTIVE.json for the
+    #:   measured arms.
+    #: - "auto" — "reduce_scatter" whenever the ZeRO layout exists
+    #:   (shard_opt_state and param_spec), else "psum".
+    grad_sync: str = "auto"
+    #: microbatch gradient accumulation: > 1 runs the step as a lax.scan
+    #: over that many microbatches of the placed batch. Under the explicit
+    #: data plane each microbatch's gradient buckets are pinned to their
+    #: shard layout INSIDE the scan body — the reduction of microbatch k is
+    #: issued with no data dependence on microbatch k+1's backward, the
+    #: lowering async collective schedulers overlap (and the scan carry
+    #: accumulates 1/N-sized shards, not full gradients). Batch dim must
+    #: divide by this count.
+    grad_accum_microbatches: int = 1
+    #: target size (MiB) of one gradient-reduction bucket in the
+    #: accumulation mode: leaves are greedily packed (reverse traversal
+    #: order — backward finishes the LAST layers' grads first) into
+    #: buckets of at most this size, bounding each issued reduction so
+    #: early buckets can reduce while later grads are still computing.
+    #: Accounting per bucket lives in `Trainer.data_plane`.
+    grad_bucket_mb: float = 4.0
     #: device-side input pipelining for ``Trainer.run``: 0 places each batch
     #: synchronously on the dispatch thread; N >= 1 runs ``place_batch``
     #: (wire encode + H2D shard placement) on a background pump thread,
@@ -122,15 +156,100 @@ class Trainer:
         self.model = model
         self.mesh = mesh
         self.config = config or TrainerConfig()
-        self.opt = _make_optimizer(self.config)
+        cfg = self.config
+        self.opt = _make_optimizer(cfg)
         #: multi-process codec agreement (edl_tpu.runtime.wire.KVCodecChannel).
         #: Required for wire_transport in multi-process jobs: every process
         #: must jit the identical decode program, so the codec is negotiated
         #: through the coordinator KV instead of inferred per-process.
         self.codec_channel = codec_channel
 
+        if cfg.grad_sync not in ("auto", "psum", "reduce_scatter"):
+            raise ValueError(
+                f"unknown grad_sync {cfg.grad_sync!r}; expected 'auto', "
+                "'psum' or 'reduce_scatter'"
+            )
+        if cfg.grad_accum_microbatches < 1:
+            raise ValueError(
+                f"grad_accum_microbatches must be >= 1, got "
+                f"{cfg.grad_accum_microbatches}"
+            )
+        zero_layout = cfg.shard_opt_state and model.param_spec is not None
+        if cfg.grad_sync == "reduce_scatter" and not zero_layout:
+            raise ValueError(
+                "grad_sync='reduce_scatter' needs the ZeRO-1 layout: set "
+                "shard_opt_state=True on a model with a param_spec (the "
+                "explicit data plane updates 1/N moment+gradient shards)"
+            )
+        #: the mode the step actually lowers with ("psum"|"reduce_scatter"):
+        #: "auto" resolves to the explicit plane whenever the ZeRO layout
+        #: exists — it moves strictly fewer bytes at identical math.
+        self.grad_sync = (
+            "reduce_scatter"
+            if zero_layout and cfg.grad_sync != "psum"
+            else "psum"
+        )
+        self._data_plane: Optional[Dict[str, Any]] = None
+
+        def _grads_and_loss(params, batch):
+            """One (micro)batch's loss and gradient, the gradient pinned to
+            its ZeRO shard layout under the explicit plane — the pin is
+            what makes the partitioner lower the cross-batch-axis
+            reduction as reduce-scatter instead of all-reduce."""
+            loss, grads = jax.value_and_grad(model.loss_fn)(params, batch, mesh)
+            if self.grad_sync == "reduce_scatter":
+                from edl_tpu.parallel.collective import constrain_to_specs
+
+                grads = constrain_to_specs(
+                    grads, self._zero_specs(grads), mesh
+                )
+            return grads, loss
+
+        def _accumulate(params, batch):
+            """Scan-based gradient accumulation: microbatch k's (bucketed)
+            reductions are issued inside the scan body with no data
+            dependence on microbatch k+1's backward, so an async-collective
+            scheduler can overlap them; under the explicit plane the carry
+            holds 1/N gradient shards, not full gradients."""
+            from edl_tpu.parallel.collective import (
+                constrain_to_specs, split_microbatches,
+            )
+
+            n_micro = cfg.grad_accum_microbatches
+            specs = (
+                model.batch_spec(mesh) if model.batch_spec is not None else None
+            )
+            micro = split_microbatches(
+                batch, n_micro, mesh, cfg.batch_axis, specs=specs
+            )
+            zero_specs = self._zero_specs(params)
+
+            def body(acc, mb):
+                grads, loss = _grads_and_loss(params, mb)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+                if self.grad_sync == "reduce_scatter":
+                    # keep the carry on the shard layout step over step
+                    acc = constrain_to_specs(acc, zero_specs, mesh)
+                return acc, loss
+
+            zeros = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.result_type(p)), params
+            )
+            if self.grad_sync == "reduce_scatter":
+                zeros = constrain_to_specs(zeros, zero_specs, mesh)
+            grads, losses = jax.lax.scan(body, zeros, micro)
+            grads = jax.tree_util.tree_map(
+                lambda g: g / np.float32(n_micro), grads
+            )
+            # equal-sized microbatches: mean of per-microbatch means IS the
+            # whole-batch mean, for the loss exactly as for the gradient
+            return grads, jnp.mean(losses)
+
         def _step(state: TrainState, batch: Dict[str, jax.Array]) -> Tuple[TrainState, jax.Array]:
-            loss, grads = jax.value_and_grad(model.loss_fn)(state.params, batch, mesh)
+            if cfg.grad_accum_microbatches > 1:
+                grads, loss = _accumulate(state.params, batch)
+            else:
+                grads, loss = _grads_and_loss(state.params, batch)
             updates, opt_state = self.opt.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             if self.config.shard_opt_state and model.param_spec is not None:
@@ -138,7 +257,9 @@ class Trainer:
                 # propagation would push the moments' data-axis sharding
                 # onto the updated params too (drifting toward an implicit
                 # ZeRO-3). Params keep their canonical layout; only the
-                # optimizer state stays sharded.
+                # optimizer state stays sharded. Under the explicit plane
+                # this pin IS the all-gather that completes the
+                # reduce-scatter → sharded-update → all-gather pipeline.
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
 
@@ -187,18 +308,46 @@ class Trainer:
             opt_state = self._shard_opt_state(opt_state)
         return TrainState(jnp.zeros((), jnp.int32), params, opt_state)
 
+    def _zero_specs(self, tree: Any) -> Any:
+        """Per-leaf ZeRO-1 shard specs for a params-shaped pytree (grads or
+        params): leaves whose param spec is fully replicated get their
+        `zero_shard_spec` over the batch axis; model-sharded leaves and
+        leaves with no divisible dim get None (left to the partitioner).
+        Must agree leaf-for-leaf with `_shard_opt_state`'s moment placement
+        — both route through `zero_shard_spec`, so the gradient shard the
+        reduce-scatter lands IS the shard the local moments cover."""
+        from jax.sharding import PartitionSpec as P
+
+        from edl_tpu.parallel.collective import zero_shard_spec
+
+        def leaf_spec(x, s):
+            if any(e is not None for e in s):
+                return None  # model-sharded param: grads keep its layout
+            shape = jnp.shape(x)
+            if len(shape) == 0:
+                return None
+            return zero_shard_spec(shape, self.mesh, self.config.batch_axis)
+
+        return jax.tree_util.tree_map(
+            leaf_spec,
+            tree,
+            self.model.param_spec(self.mesh),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
     def _shard_opt_state(self, opt_state: Any) -> Any:
         """ZeRO-1 placement: re-shard replicated moment tensors over the
-        batch axis (first divisible dim). Leaves that already carry a real
-        sharding (moments of sharded params) and scalars are untouched."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        batch axis (largest divisible dim — `zero_shard_spec`). Leaves that
+        already carry a real sharding (moments of sharded params) and
+        scalars are untouched."""
+        from jax.sharding import NamedSharding
 
-        from edl_tpu.parallel.sharding import axis_size, present_axes
+        from edl_tpu.parallel.collective import zero_shard_spec
+        from edl_tpu.parallel.sharding import present_axes
 
         axis = present_axes(self.mesh, self.config.batch_axis)
         if not axis:
             return opt_state
-        n = axis_size(self.mesh, axis)
 
         def target_sharding(x):
             """New sharding for leaves that should reshard; None otherwise.
@@ -214,12 +363,10 @@ class Trainer:
             ) or getattr(sh, "is_fully_replicated", False)
             if not replicated:
                 return None  # already sharded (e.g. embedding-table moments)
-            for dim, size in enumerate(x.shape):
-                if size % n == 0 and size > 0:
-                    spec = [None] * x.ndim
-                    spec[dim] = axis
-                    return NamedSharding(self.mesh, P(*spec))
-            return None  # no divisible dim: stays replicated
+            spec = zero_shard_spec(x.shape, self.mesh, self.config.batch_axis)
+            if spec is None:
+                return None  # no divisible dim: stays replicated
+            return NamedSharding(self.mesh, spec)
 
         # One batched device_put over just the resharded leaves (the
         # codebase's placement convention — see parallel/sharding.py).
@@ -231,6 +378,87 @@ class Trainer:
         moved = iter(jax.device_put(to_move, [t for t in targets if t is not None]))
         out = [next(moved) if t is not None else x for x, t in zip(flat, targets)]
         return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- data-plane accounting -------------------------------------------------
+
+    def data_plane(self, params: Any) -> Dict[str, Any]:
+        """Analytic per-step data-plane accounting for this trainer's
+        resolved ``grad_sync`` mode: bytes-on-wire per tier from the
+        `parallel.collective` closed forms, a bandwidth-model seconds
+        estimate (the profiler's ``collective_ms`` series), and the
+        gradient-bucket assignment the accumulation mode issues. Pure
+        shape/byte arithmetic on host — cached after the first call (the
+        layout is frozen with the mesh; a rescale builds a new Trainer).
+
+        Gradient reductions are priced once per microbatch: the transformer
+        loss psums inside `shard_map`, so its backward reduces per
+        microbatch in BOTH modes — no whole-batch deferral is assumed. The
+        param all-gather is paid once per step in either mode.
+        """
+        if self._data_plane is not None:
+            return self._data_plane
+        from edl_tpu.parallel.collective import (
+            assign_buckets,
+            collective_bytes,
+            estimate_collective_seconds,
+            zero1_step_bytes,
+        )
+        from edl_tpu.parallel.sharding import present_axes
+
+        axes = present_axes(self.mesh, self.config.batch_axis)
+        tiers = [(a, int(self.mesh.shape[a])) for a in axes]
+        leaves = jax.tree_util.tree_leaves(params)
+        leaf_nbytes = [
+            int(np.prod(jnp.shape(x), dtype=np.int64))
+            * np.dtype(jnp.result_type(x)).itemsize
+            for x in leaves
+        ]
+        zero_layout = (
+            self.config.shard_opt_state and self.model.param_spec is not None
+        )
+        if zero_layout:
+            from jax.sharding import PartitionSpec as P
+
+            flat_specs = jax.tree_util.tree_leaves(
+                self._zero_specs(params),
+                is_leaf=lambda x: x is None or isinstance(x, P),
+            )
+        else:
+            flat_specs = [None] * len(leaves)
+        sharded = float(
+            sum(nb for nb, s in zip(leaf_nbytes, flat_specs) if s is not None)
+        )
+        replicated = float(
+            sum(nb for nb, s in zip(leaf_nbytes, flat_specs) if s is None)
+        )
+        n_micro = max(1, self.config.grad_accum_microbatches)
+        step_acct = zero1_step_bytes(sharded, replicated, tiers, self.grad_sync)
+        param_acct = collective_bytes(sharded, tiers, "all_gather")
+        # per-tier totals: (grad-only share) × microbatches + one param AG
+        per_tier = {
+            name: (step_acct[name] - param_acct[name]) * n_micro
+            + param_acct[name]
+            for name, _ in tiers
+        }
+        grad_bytes = step_acct["grad_bytes"] * n_micro
+        bucket_bytes = max(1, int(self.config.grad_bucket_mb * 2**20))
+        buckets = assign_buckets(leaf_nbytes, bucket_bytes)
+        self._data_plane = {
+            "grad_sync": self.grad_sync,
+            "tiers": tiers,
+            "grad_accum_microbatches": n_micro,
+            "sharded_bytes": sharded,
+            "replicated_bytes": replicated,
+            "grad_bytes_per_step": grad_bytes,
+            "param_bytes_per_step": step_acct["param_bytes"],
+            "bytes_per_step": grad_bytes + step_acct["param_bytes"],
+            "per_tier_bytes": per_tier,
+            "collective_seconds": estimate_collective_seconds(per_tier),
+            "bucket_target_bytes": bucket_bytes,
+            "n_buckets": len(buckets),
+            "bucket_nbytes": [int(b.nbytes) for b in buckets],
+        }
+        return self._data_plane
 
     # -- stepping --------------------------------------------------------------
 
@@ -552,6 +780,7 @@ class Trainer:
         t0 = time.perf_counter()
         samples = 0
         place_seconds = 0.0
+        plane = self.data_plane(state.params)
         if profiler is not None:
             # Let the profiler's summary account FLOPs/MFU without the
             # caller having to thread the model/mesh through twice.
@@ -559,6 +788,8 @@ class Trainer:
                 profiler.model = self.model
             if getattr(profiler, "n_chips", -1) is None:
                 profiler.n_chips = max(1, self.mesh.devices.size)
+            if getattr(profiler, "data_plane", None) is None:
+                profiler.data_plane = plane
             profiler.start()
         for placed, step_fn, batch_samples, place_dt in self._dispatch_iter(
             batches, depth
@@ -571,7 +802,11 @@ class Trainer:
             if on_step is not None:
                 on_step(n, float(loss))
             if profiler is not None:
-                profiler.step(batch_samples, place_seconds=place_dt)
+                profiler.step(
+                    batch_samples,
+                    place_seconds=place_dt,
+                    collective_seconds=plane["collective_seconds"],
+                )
             losses.append(loss)
             if max_steps is not None and n >= max_steps:
                 break
@@ -585,5 +820,10 @@ class Trainer:
             "seconds": elapsed,
             "retraces": float(self.retraces),
             "place_seconds": place_seconds,
+            # analytic data-plane accounting (see Trainer.data_plane):
+            # bytes are exact for the resolved grad_sync mode, seconds are
+            # a bandwidth-model estimate, not a measurement.
+            "grad_bytes_per_step": plane["grad_bytes_per_step"],
+            "collective_seconds_est": plane["collective_seconds"] * n,
         }
         return state, metrics

@@ -55,6 +55,11 @@ class StepRecord:
     #: ``seconds``; in the pipelined loop it ran on the pump thread and
     #: overlapped an earlier step's device compute.
     place_seconds: Optional[float] = None
+    #: analytic bandwidth-model ESTIMATE of this step's data-plane
+    #: collective time (`Trainer.data_plane` — bytes-on-wire closed form
+    #: over per-tier bandwidths), not a measurement: it exposes the
+    #: bytes-vs-time structure next to the measured ``seconds``.
+    collective_seconds: Optional[float] = None
 
     def to_dict(self) -> dict:
         d = {"step": self.step, "seconds": round(self.seconds, 6), "samples": self.samples}
@@ -64,6 +69,8 @@ class StepRecord:
             d["warmup"] = True
         if self.place_seconds is not None:
             d["place_ms"] = round(self.place_seconds * 1e3, 3)
+        if self.collective_seconds is not None:
+            d["collective_ms"] = round(self.collective_seconds * 1e3, 3)
         return d
 
 
@@ -100,6 +107,10 @@ class StepProfiler:
         #: None = unset (Trainer.run fills it from its mesh); an explicit
         #: value — including 1 for whole-job figures — is never overwritten.
         self.n_chips = n_chips
+        #: None = unset; Trainer.run fills it with its `data_plane` dict so
+        #: the summary can report ``grad_bytes_per_step`` next to the
+        #: measured step times without re-deriving the byte model here.
+        self.data_plane: Optional[Dict[str, Any]] = None
         self.records: List[StepRecord] = []
         self._count = 0
         self._mark: Optional[float] = None
@@ -118,13 +129,19 @@ class StepProfiler:
         self._pending_warmup += n
 
     def step(self, samples: int, loss: Optional[float] = None,
-             place_seconds: Optional[float] = None) -> StepRecord:
+             place_seconds: Optional[float] = None,
+             collective_seconds: Optional[float] = None) -> StepRecord:
         """Record one completed step of ``samples`` examples.
 
         ``place_seconds`` — this batch's host placement time, recorded as
         its own series so the place/step split survives into jsonl sinks
         and summaries (the pipelined loop's placement happens off the
-        dispatch thread, invisible to ``seconds``)."""
+        dispatch thread, invisible to ``seconds``).
+
+        ``collective_seconds`` — the analytic data-plane collective
+        estimate for this step (`Trainer.data_plane`); a model series, not
+        a measurement, kept per-record so jsonl sinks line it up against
+        the measured ``seconds``."""
         now = time.perf_counter()
         start = self._mark if self._mark is not None else now
         is_warmup = self._count < self.warmup or self._pending_warmup > 0
@@ -132,7 +149,8 @@ class StepProfiler:
             self._pending_warmup -= 1
         rec = StepRecord(step=self._count, seconds=now - start,
                          samples=samples, loss=loss, warmup=is_warmup,
-                         place_seconds=place_seconds)
+                         place_seconds=place_seconds,
+                         collective_seconds=collective_seconds)
         self._count += 1
         self._mark = now
         self.records.append(rec)
@@ -178,6 +196,19 @@ class StepProfiler:
         if places:
             out["place_time_mean_s"] = sum(places) / len(places)
             out["place_time_p50_s"] = _percentile(places, 0.5)
+        colls = [r.collective_seconds for r in steady
+                 if r.collective_seconds is not None]
+        if colls:
+            # an estimate series (see StepRecord.collective_seconds) —
+            # constant within a mesh/layout, so mean is the whole story
+            out["collective_time_est_mean_s"] = sum(colls) / len(colls)
+        if self.data_plane is not None:
+            out["grad_bytes_per_step"] = float(
+                self.data_plane["grad_bytes_per_step"]
+            )
+            out["data_plane_bytes_per_step"] = float(
+                self.data_plane["bytes_per_step"]
+            )
         if getattr(self.model, "flops_per_step", None) is not None \
                 and total > 0 and samples:
             from edl_tpu.tools.mfu import mfu_fields

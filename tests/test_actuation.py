@@ -13,6 +13,7 @@ import time
 
 import pytest
 
+from conftest import multiprocess_on_cpu
 from edl_tpu.api.quantity import ResourceList
 from edl_tpu.api.types import TrainingJob
 from edl_tpu.api.validation import normalize
@@ -76,6 +77,7 @@ def test_inprocess_bump_epoch_matches_native():
     assert c.bump_epoch() == before + 1  # int, like CoordinatorClient's
 
 
+@multiprocess_on_cpu
 def test_autoscaler_rescales_live_two_process_job_to_three(tmp_path):
     """Full loop: ProcessCluster runs 2 real trainer processes against a real
     coordinator; the Autoscaler sees free chips, decides 2→3, publishes

@@ -284,6 +284,46 @@ def test_edl003_scope_is_parallel_and_models_by_default(tmp_path):
     assert report.findings == []
 
 
+def test_edl003_collective_helpers_are_clean():
+    """The data-plane helpers (`zero_shard_spec` builds PartitionSpecs,
+    `split_microbatches` takes an `axis` default) live under parallel/, so
+    EDL003's default scope covers them with no config — pin that they pass."""
+    report = analyze(
+        [str(REPO_ROOT / "edl_tpu" / "parallel" / "collective.py")],
+        root=str(REPO_ROOT),
+        rules=["EDL003"],
+    )
+    assert report.parse_errors == []
+    assert report.findings == []
+
+
+def test_edl003_flags_typoed_axis_in_collective_style_helper(tmp_path):
+    """A zero_shard_spec-style helper with a misspelled axis under parallel/
+    is in the default scope and gets flagged — no sharding_all_files needed."""
+    pkg = tmp_path / "parallel"
+    pkg.mkdir()
+    (pkg / "collective.py").write_text(
+        textwrap.dedent(
+            """
+            from jax.sharding import PartitionSpec as P
+
+            def zero_shard_spec(shape, batch_axis: str = "dada"):
+                spec = [None] * len(shape)
+                spec[0] = batch_axis
+                return P(*spec)
+            """
+        )
+    )
+    report = analyze(
+        [str(pkg)],
+        root=str(tmp_path),
+        rules=["EDL003"],
+        config={"sharding_axes": ["data", "dcn"]},
+    )
+    assert rules_of(report) == ["EDL003"]
+    assert "'dada'" in report.findings[0].message
+
+
 # -- EDL004: blocking while holding a lock ------------------------------------
 
 

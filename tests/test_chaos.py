@@ -22,6 +22,7 @@ import time
 
 import pytest
 
+from conftest import multiprocess_on_cpu
 from edl_tpu.api.quantity import ResourceList
 from edl_tpu.api.types import TrainingJob
 from edl_tpu.api.validation import normalize
@@ -67,6 +68,7 @@ def _job(name, server, entry, launcher, ckpt, tmp_path):
 
 
 @pytest.mark.chaos
+@multiprocess_on_cpu
 def test_two_jobs_survive_random_pod_kills(tmp_path):
     ensure_built()
     rng = random.Random(0)

@@ -5,6 +5,7 @@ import time
 
 import pytest
 
+from conftest import multiprocess_on_cpu
 from edl_tpu.coordinator.inprocess import InProcessCoordinator
 from edl_tpu.launcher.launch import LaunchContext
 from edl_tpu.runtime.distributed import (
@@ -103,6 +104,7 @@ def test_local_host_ip_shape():
     assert ip.count(".") == 3
 
 
+@multiprocess_on_cpu
 def test_two_process_jax_distributed_bringup(tmp_path):
     """THE multi-host proof: two OS processes, each with 2 virtual CPU
     devices, form one 4-device jax.distributed world via the real C++

@@ -16,6 +16,7 @@ import time
 import numpy as np
 import pytest
 
+from conftest import multiprocess_on_cpu
 from edl_tpu.runtime.data import FileShardSource, shard_seed, write_shard
 
 
@@ -113,6 +114,7 @@ def test_ctr_prepare_cli_writes_uneven_shards(tmp_path):
 # -- e2e: uneven file shards, multi-process, mid-run rescale -------------------
 
 
+@multiprocess_on_cpu
 def test_two_process_uneven_file_shards_with_midrun_rescale(tmp_path):
     """Two launcher-managed workers train genuinely uneven on-disk shards in
     lockstep; a third joins mid-run (epoch bump + expected_world), everyone

@@ -15,6 +15,7 @@ import time
 
 import pytest
 
+from conftest import multiprocess_on_cpu
 from edl_tpu.api.quantity import ResourceList
 from edl_tpu.controller.actuation import EXPECTED_WORLD_KEY, CoordinatorActuator
 from edl_tpu.controller.autoscaler import Autoscaler, AutoscalerConfig
@@ -55,6 +56,7 @@ def _job(name, min_i, max_i, launcher, server, entry, ckpt, extra_env=None):
     }))
 
 
+@multiprocess_on_cpu
 def test_ctr_and_resnet_share_cluster_fairly(tmp_path):
     """CTR at world 2 fills both hosts; a ResNet job lands Pending; the
     autoscaler shrinks CTR 2->1 (make-room), the freed chips place ResNet,

@@ -107,6 +107,55 @@ def test_trainer_run_with_profiler():
     assert s["samples_per_sec"] > 0
 
 
+def test_collective_series_and_data_plane_summary():
+    """The data-plane estimate rides the step records (`collective_ms` in the
+    sink line) and the summary surfaces `grad_bytes_per_step` +
+    `collective_time_est_mean_s` once `data_plane` is attached."""
+    sink = io.StringIO()
+    p = StepProfiler(warmup=0, sink=sink)
+    p.data_plane = {"grad_bytes_per_step": 1024.0, "bytes_per_step": 1536.0}
+    p.start()
+    p.step(samples=8, collective_seconds=0.002)
+    p.step(samples=8, collective_seconds=0.004)
+    p.step(samples=8)  # estimate omitted — must not poison the mean
+    lines = [json.loads(l) for l in sink.getvalue().splitlines()]
+    assert lines[0]["collective_ms"] == 2.0
+    assert lines[1]["collective_ms"] == 4.0
+    assert "collective_ms" not in lines[2]
+    s = p.summary()
+    assert s["collective_time_est_mean_s"] == (0.002 + 0.004) / 2
+    assert s["grad_bytes_per_step"] == 1024.0
+    assert s["data_plane_bytes_per_step"] == 1536.0
+    # without a data plane, the byte keys stay absent
+    bare = StepProfiler(warmup=0)
+    bare.start()
+    bare.step(samples=8)
+    assert "grad_bytes_per_step" not in bare.summary()
+    assert "collective_time_est_mean_s" not in bare.summary()
+
+
+def test_trainer_run_fills_data_plane():
+    """Trainer.run wires its analytic data plane into the profiler: every
+    step record carries the estimate and the summary reports bytes."""
+    mesh = local_mesh()
+    trainer = Trainer(
+        fit_a_line.MODEL, mesh, TrainerConfig(optimizer="sgd", learning_rate=0.1)
+    )
+    state = trainer.init_state()
+    rng = np.random.default_rng(0)
+    prof = StepProfiler(warmup=0)
+
+    def batches(n):
+        for _ in range(n):
+            yield fit_a_line.MODEL.synthetic_batch(rng, 64)
+
+    _, metrics = trainer.run(state, batches(3), profiler=prof)
+    assert prof.data_plane is not None
+    assert prof.data_plane["grad_sync"] == trainer.grad_sync
+    assert all(r.collective_seconds is not None for r in prof.records)
+    assert prof.summary()["grad_bytes_per_step"] == metrics["grad_bytes_per_step"]
+
+
 def test_annotations_are_usable_contexts():
     with annotation("edl/test-span"):
         pass
