@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py
 
-.PHONY: analyze analyze-json baseline test chaos lint bench-pipeline bench-coord bench-collective
+.PHONY: analyze analyze-json baseline test chaos lint obs-smoke bench-pipeline bench-coord bench-collective
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -25,6 +25,13 @@ test:
 ## process-kill soaks tier-1 skips.
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
+
+## Telemetry-plane deploy gate: boots a worker with its /metrics endpoint
+## against a real coordinator, scrapes over HTTP while training runs, and
+## asserts every required metric family (worker, client, bridged
+## coordinator) is present. See doc/observability.md.
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.obs
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -22,7 +22,9 @@ collector loop example/fit_a_line/collector.py:215-226):
 
 Run on the CPU simulation mesh by default (8 virtual devices; CI-stable);
 the same script runs unmodified on real chips. Writes BENCH_RESCALE.json
-and prints it.
+plus RESCALE_TIMELINE.json — the stitched worker+controller span breakdown
+of the rescale (drain -> checkpoint -> warm_compile/restore -> first_step
+under one shared trace id; see doc/observability.md) — and prints both.
 """
 
 from __future__ import annotations
@@ -89,6 +91,9 @@ def main() -> None:
     from edl_tpu.runtime.checkpoint import (
         Checkpointer, abstract_like, live_state_specs,
     )
+    from edl_tpu.obs.tracing import (
+        RESCALE_PHASES, Tracer, rescale_timeline, rescale_trace_id,
+    )
     import numpy as np
 
     import tempfile
@@ -111,7 +116,7 @@ def main() -> None:
     half = max(1, full // 2)
     tcfg = TrainerConfig(optimizer="sgd", learning_rate=0.05)
 
-    def run_worker(tag: str, planner, join: bool):
+    def run_worker(tag: str, planner, join: bool, tracer=None):
         """One full worker run over the identical workload/config; only the
         device plan and the mid-run membership change differ — so retention
         compares elastic-after-rescale against static on the SAME pipeline
@@ -127,11 +132,16 @@ def main() -> None:
                 server.client("trainer-0"),
                 SyntheticShardSource(model, batch_size=batch_size,
                                      batches_per_shard=batches_per_shard),
+                # heartbeat_interval bounds epoch-change DETECTION latency;
+                # at 0.2 s a warm XLA cache could drain the whole queue
+                # before the first beat saw the bump ("no rescale happened"
+                # flake) — 0.05 s keeps detection well inside the workload.
                 ElasticConfig(checkpoint_dir=os.path.join(workdir, "ck"),
-                              checkpoint_interval=50, heartbeat_interval=0.2,
+                              checkpoint_interval=50, heartbeat_interval=0.05,
                               rescale_barrier_timeout=30.0, trainer=tcfg),
                 device_planner=planner,
                 profiler=prof,
+                tracer=tracer,
             )
             stop = threading.Event()
             t = None
@@ -144,12 +154,23 @@ def main() -> None:
                     and follows the rendezvous protocol."""
                     while worker.steps_done < 10 and not stop.is_set():
                         time.sleep(0.02)
+                    actuate_t0 = time.time()
                     actuator = CoordinatorActuator()
                     actuator.set_endpoint(tag, "127.0.0.1", server.port)
                     actuator.publish_expected_world(tag, 2)
                     joiner = server.client("trainer-1")
                     info = joiner.register()  # membership event -> epoch bump
                     epoch = info["epoch"]
+                    if tracer is not None:
+                        # The register reply carries the bumped epoch — the
+                        # same rescale correlator the worker stamps on its
+                        # drain/checkpoint/restore spans, so the controller
+                        # side stitches onto the same timeline with no
+                        # propagation header (doc/observability.md).
+                        tracer.record("actuate", actuate_t0, time.time(),
+                                      trace_id=rescale_trace_id(epoch),
+                                      component="controller", job=tag,
+                                      world=2)
                     while not stop.is_set():
                         reply = joiner.sync(epoch, timeout=5.0)
                         if reply.get("ok"):
@@ -177,8 +198,12 @@ def main() -> None:
     static_per_chip = _steady_rate(static_prof.phases[-1]) / full
 
     # -- elastic run: 1 -> 2 trainers through the real actuator path ----------
+    # One tracer shared by the worker (drain/checkpoint/warm_compile/restore/
+    # first_step spans) and the bench's control-plane thread (the actuate
+    # span): exactly what a JSONL-stream merge of two pods' sinks would hold.
+    trace = Tracer(component="bench")
     worker, prof, metrics, workdir = run_worker(
-        "rb", lambda w: devs[: min(full, w * half)], join=True
+        "rb", lambda w: devs[: min(full, w * half)], join=True, tracer=trace
     )
 
     assert worker.rescales, "no rescale happened; bench invalid"
@@ -244,11 +269,52 @@ def main() -> None:
             "backend": jax.default_backend(),
         },
     }
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "BENCH_RESCALE.json")
+    here = os.path.dirname(os.path.abspath(__file__))
+    out = os.path.join(here, "BENCH_RESCALE.json")
     with open(out, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
+
+    # -- the stitched rescale timeline (RESCALE_TIMELINE.json) ----------------
+    # The cold-start trace id carries warm_compile/restore/first_step only;
+    # the REAL rescale's id carries the full lifecycle, controller included —
+    # that one is the headline artifact.
+    timeline = rescale_timeline(trace.spans)
+    complete = {
+        tid: t for tid, t in timeline.items()
+        if all(p in t["phases"] for p in RESCALE_PHASES)
+    }
+    phases_seen = {tid: sorted(t["phases"]) for tid, t in timeline.items()}
+    assert complete, (
+        f"no trace carries every lifecycle phase {RESCALE_PHASES}; "
+        f"saw {phases_seen}"
+    )
+    rid, breakdown = sorted(complete.items())[-1]  # latest epoch = the rescale
+    timeline_doc = {
+        "rescale_trace_id": rid,
+        "phase_order": list(RESCALE_PHASES),
+        "phases": {
+            name: {
+                "seconds": round(ph["seconds"], 6),
+                "start": round(ph["start"], 6),
+                "end": round(ph["end"], 6),
+                "component": ph["component"],
+                "count": ph["count"],
+            }
+            for name, ph in breakdown["phases"].items()
+        },
+        "components": breakdown["components"],
+        "wall_seconds": round(breakdown["wall_seconds"], 6),
+        "span_count": breakdown["span_count"],
+        "note": (
+            "phase seconds may sum past wall_seconds: warm_compile runs "
+            "concurrent with restore by design (see doc/observability.md)"
+        ),
+    }
+    tl_out = os.path.join(here, "RESCALE_TIMELINE.json")
+    with open(tl_out, "w") as f:
+        json.dump(timeline_doc, f, indent=1)
+    print(json.dumps(timeline_doc))
 
 
 if __name__ == "__main__":

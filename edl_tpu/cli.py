@@ -10,6 +10,11 @@ kubectl-side workflow its docs walk through (`doc/usage.md:81-118`):
   to a terminal phase (the `kubectl create -f && watch` loop, hermetic).
 - ``train``     — run a model from the zoo locally on the live JAX backend
   (the `train_local.py` twin, `example/fit_a_line/train_local.py:41-109`).
+- ``status``    — query a running coordinator's counters (ops, fsyncs,
+  journal records, per-worker leases) over the wire protocol.
+
+``--log-format json`` (anywhere on the command line) switches every
+subcommand to one-JSON-object-per-line logging (`edl_tpu.obs.logs`).
 
 ``controller``/``run`` pick their backend the way `cmd/edl/edl.go:31-36`
 does: ``--in-cluster`` uses the pod serviceaccount, ``--kubeconfig`` (or a
@@ -175,6 +180,16 @@ def cmd_run(args) -> int:
 def cmd_controller(args) -> int:
     from edl_tpu.k8s.config import ConfigError
 
+    server = None
+    if args.metrics_port is not None:
+        # One scrape covers the whole control plane: the collector's cluster
+        # gauges, autoscaler decisions, and actuation counters all live in
+        # the process registry this endpoint serves.
+        from edl_tpu.obs.http import MetricsServer
+
+        server = MetricsServer(port=args.metrics_port).start()
+        logging.getLogger("edl_tpu.cli").info(
+            "controller metrics at %s/metrics", server.url)
     try:
         with _control_plane(args, sink=sys.stdout):
             logging.getLogger("edl_tpu").info("controller running; Ctrl-C to stop")
@@ -186,6 +201,51 @@ def cmd_controller(args) -> int:
     except ConfigError as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
+    finally:
+        if server is not None:
+            server.stop()
+
+
+def cmd_status(args) -> int:
+    """Pretty-print (or JSON-dump) a live coordinator's status counters."""
+    from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
+
+    try:
+        client = CoordinatorClient(
+            args.host, args.port, worker="edl-cli-status",
+            connect_timeout=args.timeout, retry=None, token=args.token,
+        )
+        with client:
+            status = client.call("status", timeout=args.timeout)
+    except (CoordinatorError, OSError) as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+    ok = bool(status.get("ok"))
+    if args.json:
+        print(json.dumps(status, indent=2, sort_keys=True))
+        return 0 if ok else 1
+    counters = [
+        "epoch", "world", "queued", "leased", "done",
+        "ops", "batch_frames", "batch_subops",
+        "fsyncs", "snapshots", "journal_records", "turns",
+        "uptime_seconds",
+    ]
+    present = [k for k in counters if k in status]
+    width = max((len(k) for k in present), default=1)
+    print(f"coordinator {args.host}:{args.port} "
+          f"({'ok' if ok else 'NOT OK'})")
+    for k in present:
+        v = status[k]
+        if isinstance(v, float):
+            v = int(v) if float(v).is_integer() else round(v, 3)
+        print(f"  {k:<{width}}  {v}")
+    holders = status.get("lease_holders") or []
+    if holders:
+        print("  per-worker leases:")
+        for item in holders:
+            worker, _, count = str(item).rpartition("=")
+            print(f"    {worker:<24} {count}")
+    return 0 if ok else 1
 
 
 def cmd_train(args) -> int:
@@ -220,12 +280,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                                      description="TPU-native elastic training framework")
     parser.add_argument("--log-level", default="info",
                         choices=["debug", "info", "warning", "error"])
+    parser.add_argument("--log-format", default="text",
+                        choices=["text", "json"],
+                        help="json = one JSON object per log line "
+                             "(machine-parsed pod logs)")
     # Accept --log-level on either side of the subcommand (deploy manifests
     # put flags after it, k8s-style). SUPPRESS keeps the child from
     # overwriting a value parsed by the root.
     common = argparse.ArgumentParser(add_help=False)
     common.add_argument("--log-level", default=argparse.SUPPRESS,
                         choices=["debug", "info", "warning", "error"])
+    common.add_argument("--log-format", default=argparse.SUPPRESS,
+                        choices=["text", "json"])
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("validate", help="admission-check a TrainingJob YAML",
@@ -246,9 +312,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("controller", help="run the control plane", parents=[common])
     p.add_argument("--max-load-desired", type=float, default=0.97)
     p.add_argument("--collect-period", type=float, default=10.0)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve /metrics + /healthz on this port (0 = ephemeral)")
     _add_nodes_flags(p)
     _add_backend_flags(p)
     p.set_defaults(fn=cmd_controller)
+
+    p = sub.add_parser("status", help="query a running coordinator's counters",
+                       parents=[common])
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=7164)
+    p.add_argument("--token", default=None,
+                   help="job auth token (default: $EDL_COORD_TOKEN)")
+    p.add_argument("--timeout", type=float, default=5.0)
+    p.add_argument("--json", action="store_true", help="print the raw status reply")
+    p.set_defaults(fn=cmd_status)
 
     p = sub.add_parser("train", help="train a zoo model locally", parents=[common])
     p.add_argument("--model", default="fit_a_line")
@@ -260,10 +338,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_train)
 
     args = parser.parse_args(argv)
-    logging.basicConfig(
-        level=getattr(logging, args.log_level.upper()),
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from edl_tpu.obs.logs import configure_logging
+
+    configure_logging(level=args.log_level, fmt=args.log_format)
     return args.fn(args)
 
 

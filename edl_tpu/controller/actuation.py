@@ -24,12 +24,22 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Dict, Tuple
 
 from edl_tpu.api.types import TrainingJob
 from edl_tpu.controller.jobparser import coordinator_endpoint
+from edl_tpu.obs.metrics import get_registry
+from edl_tpu.obs.tracing import get_tracer, rescale_trace_id
 
-log = logging.getLogger("edl_tpu.actuation")
+#: rescale actuations that reached the job's coordinator (epoch bumped).
+_M_NUDGES = get_registry().counter(
+    "edl_controller_nudges_total",
+    "epoch-bump actuations delivered to job coordinators, by kind",
+    labelnames=("kind",),  # nudge | publish_and_nudge
+)
+
+log = logging.getLogger("edl_tpu.controller.actuation")
 
 #: KV key the runtime reads its target world size from
 #: (must match edl_tpu/runtime/distributed.py:EXPECTED_WORLD_KEY).
@@ -135,12 +145,19 @@ class CoordinatorActuator:
 
     def nudge(self, job_name: str) -> bool:
         """Bump the membership epoch so parked workers resync now."""
+        t0 = time.time()
         try:
             client = self._dial(job_name)
             if client is None:
                 return False
             with client:
                 epoch = client.bump_epoch()
+            # The bump_epoch reply hands us the SAME epoch every worker will
+            # adopt on re-register — the cross-process rescale correlator.
+            get_tracer().record("actuate", t0, time.time(),
+                                trace_id=rescale_trace_id(epoch),
+                                component="controller", job=job_name)
+            _M_NUDGES.inc(kind="nudge")
             log.info("nudged %s to epoch %d", job_name, epoch)
             return True
         except Exception as e:
@@ -161,6 +178,7 @@ class CoordinatorActuator:
         outside the coordinator's network, e.g. a DNS name that only
         resolves in-cluster; workers then fall back to termination-driven
         membership events and poll/TTL timeouts)."""
+        t0 = time.time()
         try:
             client = self._dial(job_name, force=True)
             if client is None:
@@ -169,6 +187,11 @@ class CoordinatorActuator:
             with client:
                 client.kv_put(EXPECTED_WORLD_KEY, str(int(world)))
                 epoch = client.bump_epoch()
+            get_tracer().record("actuate", t0, time.time(),
+                                trace_id=rescale_trace_id(epoch),
+                                component="controller", job=job_name,
+                                world=int(world))
+            _M_NUDGES.inc(kind="publish_and_nudge")
             log.info("published world=%d and nudged %s to epoch %d",
                      world, job_name, epoch)
             return True

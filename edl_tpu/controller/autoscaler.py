@@ -33,8 +33,22 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 from edl_tpu.api.quantity import ResourceList
 from edl_tpu.api.types import ScaleRecord, TrainingJob
 from edl_tpu.controller.cluster import ClusterProvider, ClusterResource
+from edl_tpu.obs.metrics import get_registry
 
-log = logging.getLogger("edl_tpu.autoscaler")
+log = logging.getLogger("edl_tpu.controller.autoscaler")
+
+# Controller-plane scale telemetry: decision counts split by why (autoscale
+# vs make-room — the shrink-to-admit path) and which way replicas moved.
+_REG = get_registry()
+_M_SCALE_EVENTS = _REG.counter(
+    "edl_controller_scale_events_total",
+    "actuated rescale decisions, by trigger and direction",
+    labelnames=("reason", "direction"),
+)
+_M_PLAN_JOBS = _REG.gauge(
+    "edl_controller_plan_jobs",
+    "jobs in the last scaling plan (0 = steady state)",
+)
 
 
 @dataclass
@@ -358,6 +372,7 @@ class Autoscaler:
             if diff.get(s.name, 0) != 0
         }
         self.last_plan = dict(target)
+        _M_PLAN_JOBS.set(float(len(target)))
         if target:
             log.info("scaling plan: %s (%s)", target, reason)
         self._actuate(target, reason)
@@ -403,6 +418,10 @@ class Autoscaler:
                     self.cluster.set_trainer_parallelism(name, parallelism)
                     if self.actuator is not None and not shrink:
                         self.actuator.nudge(name)
+                    _M_SCALE_EVENTS.inc(
+                        reason=reason,
+                        direction="shrink" if shrink else "grow",
+                    )
                     record = ScaleRecord(
                         timestamp=time.time(),
                         from_replicas=before,

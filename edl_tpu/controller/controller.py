@@ -31,7 +31,7 @@ from edl_tpu.controller.cluster import ClusterProvider
 from edl_tpu.controller.store import FuncWatcher, JobStore
 from edl_tpu.controller.updater import JobUpdater, UpdaterConfig
 
-log = logging.getLogger("edl_tpu.controller")
+log = logging.getLogger("edl_tpu.controller.controller")
 
 
 class Controller:

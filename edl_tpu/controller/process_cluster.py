@@ -32,7 +32,7 @@ from typing import Dict, List, Optional
 from edl_tpu.api.quantity import ResourceList
 from edl_tpu.controller.cluster import NodeInfo, PodInfo, inquire_resource
 
-log = logging.getLogger("edl_tpu.process_cluster")
+log = logging.getLogger("edl_tpu.controller.process_cluster")
 
 
 @dataclass

@@ -36,7 +36,7 @@ from edl_tpu.controller.jobparser import (
 )
 from edl_tpu.controller.store import JobStore
 
-log = logging.getLogger("edl_tpu.updater")
+log = logging.getLogger("edl_tpu.controller.updater")
 
 #: event-queue capacity + warning threshold (ref: trainingJobUpdater.go:19-26).
 EVENT_QUEUE_CAP = 1000

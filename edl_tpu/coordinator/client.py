@@ -17,6 +17,36 @@ import uuid
 from typing import Dict, List, Optional
 
 from edl_tpu.coordinator.retry import DEFAULT_RETRY, RetryPolicy
+from edl_tpu.obs.metrics import get_registry
+
+# Process-wide client telemetry (all CoordinatorClient instances in this
+# process feed the same families — per-connection split isn't worth a label).
+_REG = get_registry()
+_M_CALLS = _REG.counter(
+    "edl_client_calls_total",
+    "coordinator RPC transactions completed, by op",
+    labelnames=("op",),
+)
+_M_RETRIES = _REG.counter(
+    "edl_client_retries_total",
+    "transport-level re-dial attempts (coordinator unreachable)",
+)
+_M_RECONNECTS = _REG.counter(
+    "edl_client_reconnects_total",
+    "fresh TCP connections established after a poisoned/closed socket",
+)
+_M_BATCH_FRAMES = _REG.counter(
+    "edl_client_batch_frames_total",
+    "batched frames sent (each carries many sub-ops in one round-trip)",
+)
+_M_CALL_LATENCY = _REG.histogram(
+    "edl_client_call_latency_seconds",
+    "coordinator RPC round-trip latency (excludes ops parked server-side: "
+    "barrier/sync wait time is rendezvous, not transport)",
+)
+#: parked ops: their round-trip time measures rendezvous latency, which
+#: would swamp the transport histogram with multi-second waits.
+_PARKED_OPS = frozenset({"barrier", "sync"})
 
 
 class CoordinatorError(RuntimeError):
@@ -187,6 +217,7 @@ class CoordinatorClient:
                 if time.monotonic() + delay >= deadline:
                     raise
                 self.retry_count += 1  # edl: noqa[EDL001] telemetry counter; a torn increment under-counts a metric, never corrupts protocol state
+                _M_RETRIES.inc()
                 time.sleep(delay)
 
     def call_batch(self, ops: List, timeout: Optional[float] = None) -> List[Dict]:
@@ -209,6 +240,7 @@ class CoordinatorClient:
                 op, fields = item
                 req = {"op": op, **fields}
             encoded.append(json.dumps(req, ensure_ascii=False))
+        _M_BATCH_FRAMES.inc()
         reply = self.call("batch", timeout=timeout, ops=encoded)
         if not reply.get("ok"):
             raise CoordinatorError(f"batch frame rejected: {reply.get('error')}")
@@ -269,8 +301,10 @@ class CoordinatorClient:
         # the transaction must be atomic per thread — unlike the
         # coordinator's service lock, nothing latency-critical serializes
         # behind it except other requests on this same connection.
+        t0 = time.perf_counter()
         with self._lock:
             if self._sock is None:
+                _M_RECONNECTS.inc()
                 # A previous timeout/error poisoned the connection (a late
                 # reply may still be in flight, which would desync
                 # request/reply pairing) — start a fresh one. The re-dial
@@ -312,6 +346,9 @@ class CoordinatorClient:
                 if self._sock is not None:
                     self._sock.settimeout(None)
             line, self._buf = self._buf.split(b"\n", 1)
+        _M_CALLS.inc(op=op)
+        if op not in _PARKED_OPS:
+            _M_CALL_LATENCY.observe(time.perf_counter() - t0)
         reply = json.loads(line)
         if isinstance(reply, dict) and reply.get("unauthorized"):
             raise CoordinatorAuthError(

@@ -29,6 +29,7 @@ class InProcessCoordinator:
         self.auth_token = auth_token or ""
         self._lock = threading.RLock()
         self._barrier_cv = threading.Condition(self._lock)
+        self._boot_monotonic = time.monotonic()
         self._epoch = 0
         self._next_rank = 0
         self._members: Dict[str, Dict] = {}  # name -> {rank, last_heartbeat}
@@ -331,6 +332,9 @@ class InProcessCoordinator:
     def status(self) -> Dict:
         with self._lock:
             self._tick()
+            holders: Dict[str, int] = {}
+            for lease in self._leased.values():
+                holders[lease["worker"]] = holders.get(lease["worker"], 0) + 1
             return {
                 "ok": True,
                 "epoch": self._epoch,
@@ -338,6 +342,12 @@ class InProcessCoordinator:
                 "queued": len(self._todo),
                 "leased": len(self._leased),
                 "done": len(self._done),
+                "uptime_seconds": time.monotonic() - self._boot_monotonic,
+                # native-parity encoding: flat "worker=count" strings (the
+                # wire writer has no nested objects, so neither do we).
+                "lease_holders": sorted(
+                    f"{w}={n}" for w, n in holders.items()
+                ),
             }
 
     def ping(self) -> bool:

@@ -36,7 +36,7 @@ from edl_tpu.controller.jobparser import (
 )
 from edl_tpu.k8s.client import ApiClient, ApiError
 
-log = logging.getLogger("edl_tpu.k8s")
+log = logging.getLogger("edl_tpu.k8s.cluster")
 
 #: the TPU chip resource as GKE exposes it; mapped to the internal "tpu" key.
 TPU_RESOURCE = "google.com/tpu"

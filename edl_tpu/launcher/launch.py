@@ -30,7 +30,7 @@ from typing import Dict, List, Optional
 from edl_tpu.coordinator.client import CoordinatorError
 from edl_tpu.launcher.discovery import wait_coordinator
 
-log = logging.getLogger("edl_tpu.launcher")
+log = logging.getLogger("edl_tpu.launcher.launch")
 
 #: coordinator KV key counting trainer process failures job-wide.
 FAILED_COUNT_KEY = "edl/trainer_failed_count"
@@ -330,11 +330,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--port", type=int, default=None,
                         help="override EDL_PORT (coordinator role)")
     parser.add_argument("--entry", default=None, help="override EDL_ENTRY")
+    parser.add_argument("--log-level", default="info",
+                        choices=["debug", "info", "warning", "error"])
+    parser.add_argument("--log-format", default=os.environ.get(
+                            "EDL_LOG_FORMAT", "text"),
+                        choices=["text", "json"],
+                        help="json = one JSON object per log line; also "
+                             "settable via EDL_LOG_FORMAT (pod manifests)")
     args = parser.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s"
-    )
+    from edl_tpu.obs.logs import configure_logging
+
+    configure_logging(level=args.log_level, fmt=args.log_format)
     ctx = LaunchContext.from_env()
     if args.port is not None:
         ctx.port = args.port

@@ -1,0 +1,75 @@
+"""Unified telemetry plane: metrics registry, exposition, span tracing.
+
+The reference EDL's observability is logs-only — log15 levels
+(`cmd/edl/edl.go:26-28`), ``GLOG_v`` on pods, pass-elapsed prints in
+examples (SURVEY §5 flags that as the bar to clear). Our own signals were
+fragmented before this package: `StepProfiler` per-step series,
+`collector.py` JSONL samples, the native coordinator's op counters locked
+inside its ``status`` reply, outbox/retry state never leaving the client.
+
+This package is the one place they all meet:
+
+- :mod:`edl_tpu.obs.metrics` — process-wide registry of counters, gauges
+  and histograms (with labels), rendered as Prometheus text exposition and
+  as JSON snapshots. Stdlib-only, import-cheap (no jax).
+- :mod:`edl_tpu.obs.tracing` — structured spans with cross-process
+  correlation ids (the membership epoch is the correlator for rescales),
+  JSONL event streams, and the timeline stitcher that turns worker +
+  controller spans into a phase-attributed recovery breakdown.
+- :mod:`edl_tpu.obs.http` — `/metrics` + `/healthz` (+ `/spans`) on a
+  stdlib HTTP server, for workers and the controller alike.
+- :mod:`edl_tpu.obs.bridge` — maps the native coordinator's ``status``
+  counters (ops, frames, fsyncs, turns, journal records, per-worker
+  leases) into the same registry, so one scrape sees control plane and
+  data plane together.
+- :mod:`edl_tpu.obs.logs` — ``--log-format json`` structured logging for
+  pod-parseable logs.
+- :mod:`edl_tpu.obs.instruments` — the shared worker instrument set
+  (heartbeat latency, outbox depth, degraded seconds, epochs) used by
+  `ElasticWorker` and `MultiHostWorker`.
+
+See doc/observability.md for the span model and the rescale timeline
+anatomy (`RESCALE_TIMELINE.json`).
+"""
+
+from edl_tpu.obs.bridge import CoordinatorStatusBridge
+from edl_tpu.obs.http import MetricsServer, scrape_metrics
+from edl_tpu.obs.instruments import WorkerInstruments
+from edl_tpu.obs.logs import JsonLogFormatter, configure_logging
+from edl_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    parse_prometheus,
+)
+from edl_tpu.obs.tracing import (
+    Span,
+    Tracer,
+    get_tracer,
+    load_spans,
+    rescale_timeline,
+    rescale_trace_id,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "parse_prometheus",
+    "Span",
+    "Tracer",
+    "get_tracer",
+    "load_spans",
+    "rescale_timeline",
+    "rescale_trace_id",
+    "MetricsServer",
+    "scrape_metrics",
+    "CoordinatorStatusBridge",
+    "WorkerInstruments",
+    "JsonLogFormatter",
+    "configure_logging",
+]

@@ -1,0 +1,100 @@
+"""Shared instrument sets for the runtime's moving parts.
+
+The metric *names* live here, once: `ElasticWorker` and `MultiHostWorker`
+record into the same families, so dashboards and the obs smoke target
+don't care which worker flavor a pod runs. Creation is get-or-create
+against the process registry, so constructing a second worker in one
+process (tests, benches) reuses the same instruments.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from edl_tpu.obs.metrics import MetricsRegistry, get_registry
+
+__all__ = ["WorkerInstruments"]
+
+
+class WorkerInstruments:
+    """The worker-side sensor suite: heartbeat latency, outbox depth,
+    degraded-mode time, epoch observations, rescales, parks."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.heartbeat_latency = r.histogram(
+            "edl_worker_heartbeat_latency_seconds",
+            "coordinator heartbeat round-trip time (dedicated beats only; "
+            "coalesced beats cost no RPC and record no latency)",
+        )
+        self.heartbeats = r.counter(
+            "edl_worker_heartbeats_total",
+            "heartbeat observations, by transport path",
+            labelnames=("path",),  # dedicated | coalesced
+        )
+        self.outbox_depth = r.gauge(
+            "edl_worker_outbox_depth",
+            "mutations buffered for replay (degraded mode)",
+        )
+        self.degraded_seconds = r.gauge(
+            "edl_worker_degraded_seconds",
+            "seconds of the CURRENT coordinator outage (0 while reachable)",
+        )
+        self.outage_seconds_total = r.gauge(
+            "edl_worker_outage_seconds_total",
+            "cumulative seconds spent with the coordinator unreachable",
+        )
+        self.epoch = r.gauge(
+            "edl_worker_epoch",
+            "membership epoch this worker last adopted",
+        )
+        self.epoch_observations = r.counter(
+            "edl_worker_epoch_observations_total",
+            "membership epoch adoptions (register / rescale / outage rejoin)",
+        )
+        self.rescales = r.counter(
+            "edl_worker_rescales_total",
+            "completed elastic rescales (first post-rescale step done)",
+        )
+        self.parks = r.counter(
+            "edl_worker_parks_total",
+            "times the outage budget expired and the worker checkpointed and parked",
+        )
+        self.steps = r.counter(
+            "edl_worker_steps_total",
+            "optimizer steps completed by this process",
+        )
+
+    # -- convenience recorders -------------------------------------------------
+
+    def timed_heartbeat(self, client):
+        """``client.heartbeat()`` with latency + path accounting."""
+        t0 = time.perf_counter()
+        reply = client.heartbeat()
+        self.heartbeat_latency.observe(time.perf_counter() - t0)
+        self.heartbeats.inc(path="dedicated")
+        return reply
+
+    def note_coalesced_heartbeat(self) -> None:
+        self.heartbeats.inc(path="coalesced")
+
+    def note_outage_state(self, client) -> None:
+        """Refresh degraded-mode gauges from an OutboxClient-surface client.
+        Safe on plain clients (missing surface reads as healthy)."""
+        outage_seconds = getattr(client, "outage_seconds", None)
+        self.degraded_seconds.set(
+            float(outage_seconds()) if callable(outage_seconds) else 0.0
+        )
+        outbox = getattr(client, "outbox", None)
+        self.outbox_depth.set(float(len(outbox)) if outbox is not None else 0.0)
+        total = getattr(client, "outage_total_seconds", None)
+        if isinstance(total, (int, float)):
+            self.outage_seconds_total.set(
+                float(total)
+                + (float(outage_seconds()) if callable(outage_seconds) else 0.0)
+            )
+
+    def note_epoch(self, epoch: int) -> None:
+        self.epoch.set(float(epoch))
+        self.epoch_observations.inc()

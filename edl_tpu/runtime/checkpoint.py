@@ -24,7 +24,7 @@ import jax
 import orbax.checkpoint as ocp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-log = logging.getLogger("edl_tpu.checkpoint")
+log = logging.getLogger("edl_tpu.runtime.checkpoint")
 
 
 def live_state_specs(state: Any) -> Any:

@@ -45,7 +45,7 @@ from typing import Optional
 
 from edl_tpu.coordinator import CoordinatorError
 
-log = logging.getLogger("edl_tpu.distributed")
+log = logging.getLogger("edl_tpu.runtime.distributed")
 
 #: KV key prefix rank 0 publishes the jax.distributed endpoint under; the
 #: membership epoch is appended so peers never read a stale address.

@@ -34,13 +34,15 @@ from jax.sharding import Mesh
 
 from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.models.base import Model
+from edl_tpu.obs.instruments import WorkerInstruments
+from edl_tpu.obs.tracing import Tracer, get_tracer, rescale_trace_id
 from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.data import LeaseReader, split_pass
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
 from edl_tpu.runtime.wire import WireRestartRequired
 
-log = logging.getLogger("edl_tpu.elastic")
+log = logging.getLogger("edl_tpu.runtime.elastic")
 
 
 @dataclass
@@ -91,6 +93,11 @@ class ElasticConfig:
     #: and parks, polling for the coordinator's return. See
     #: doc/robustness.md for the full failure model.
     outage_budget: float = 60.0
+    #: serve ``/metrics`` + ``/healthz`` + ``/spans`` from this worker
+    #: process on the given port (0 = ephemeral); None disables. The
+    #: endpoint also bridges the coordinator's status counters, so one
+    #: scrape of any worker sees control plane and data plane together.
+    metrics_port: Optional[int] = None
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
 
@@ -146,6 +153,7 @@ class ElasticWorker:
         device_planner: Optional[Callable[[int], Sequence[jax.Device]]] = None,
         mesh_axes: Optional[Dict[str, int]] = None,
         profiler=None,  # optional edl_tpu.tools.profiler.StepProfiler
+        tracer: Optional[Tracer] = None,
     ):
         if not config.checkpoint_dir:
             raise ValueError("ElasticConfig.checkpoint_dir is required")
@@ -159,6 +167,11 @@ class ElasticWorker:
         self.planner = device_planner or default_device_planner(4)
         self.mesh_axes = mesh_axes  # extra non-data axes, sized per full mesh
         self.profiler = profiler
+        #: rescale lifecycle spans land here (shared process tracer unless a
+        #: test/bench passes its own); correlated cross-process via the
+        #: membership epoch (obs.tracing.rescale_trace_id).
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.obs = WorkerInstruments()
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.rescales: List[RescaleEvent] = []
         self.steps_done = 0
@@ -185,6 +198,10 @@ class ElasticWorker:
         #: True between observing the coordinator unreachable and the next
         #: successful control-plane call — gates benign epoch adoption.
         self._outage_open = False
+        #: wall time _epoch_changed first decided to interrupt — the drain
+        #: span's start (signal -> step loop quiesced), 0.0 when no signal
+        #: is pending.
+        self._drain_signal_t = 0.0
         #: times the worker hit the outage budget and parked.
         self.parks = 0
         #: completion lag (at-least-once across hard crashes): shards whose
@@ -207,6 +224,7 @@ class ElasticWorker:
         self._epoch = info["epoch"]
         self._world = max(1, info["world"])
         self._rank = int(info.get("rank", -1))
+        self.obs.note_epoch(self._epoch)
 
     def _sync_membership(self) -> None:
         # run() entry = incarnation boundary: a predecessor's leases (same
@@ -228,6 +246,7 @@ class ElasticWorker:
         logged = False
         while True:
             reply = self.client.register(takeover=takeover)
+            self.obs.note_outage_state(self.client)
             if reply.get("ok"):
                 self._outage_open = False
                 if logged:
@@ -248,6 +267,14 @@ class ElasticWorker:
         return max(0.0, self.config.heartbeat_interval
                    * (1.0 + self.config.heartbeat_jitter
                       * (2.0 * self._hb_rng.random() - 1.0)))
+
+    def _signal_drain(self) -> bool:
+        """Mark the instant the interrupt decision was made (the drain
+        span's start — first signal wins: quiesce time is measured from the
+        earliest observation, not the latest re-confirmation)."""
+        if not self._drain_signal_t:
+            self._drain_signal_t = time.time()
+        return True
 
     def _epoch_changed(self, force: bool = False) -> bool:
         """Heartbeat (rate-limited) and report whether membership moved.
@@ -273,8 +300,10 @@ class ElasticWorker:
                 and now - lm_at < self.config.heartbeat_interval):
             reply = dict(lm)
             self.hb_coalesced += 1
+            self.obs.note_coalesced_heartbeat()
         else:
-            reply = self.client.heartbeat()
+            reply = self.obs.timed_heartbeat(self.client)
+        self.obs.note_outage_state(self.client)
         if reply.get("unreachable"):
             self._outage_open = True
             outage = self.client.outage_seconds()
@@ -282,7 +311,7 @@ class ElasticWorker:
                 log.warning(
                     "coordinator unreachable %.1fs (budget %.1fs): "
                     "checkpoint-and-park", outage, self.config.outage_budget)
-                return True
+                return self._signal_drain()
             return False
         rejoined = False
         if not reply.get("ok"):
@@ -292,11 +321,13 @@ class ElasticWorker:
             reply = self.client.register(takeover=False)
             if reply.get("unreachable"):
                 self._outage_open = True
-                return self.client.outage_seconds() > self.config.outage_budget
+                if self.client.outage_seconds() > self.config.outage_budget:
+                    return self._signal_drain()
+                return False
             if not reply.get("ok") or "epoch" not in reply:
                 # Repeated failure: fall back to the rendezvous path, which
                 # re-registers until membership settles.
-                return True
+                return self._signal_drain()
             rejoined = True
         if self._outage_open or rejoined:
             self._outage_open = False
@@ -318,7 +349,7 @@ class ElasticWorker:
         if reply["epoch"] == self._epoch:
             self._rank = int(reply.get("rank", self._rank))
             return False
-        return True
+        return self._signal_drain()
 
     def _rendezvous(self) -> None:
         """Agree on (epoch, world) with every live member before building the
@@ -392,7 +423,8 @@ class ElasticWorker:
                 for k, v in batch.items()
             }
 
-    def _start_warm_compile(self, trainer: Trainer, fresh: TrainState):
+    def _start_warm_compile(self, trainer: Trainer, fresh: TrainState,
+                            trace_id: str = ""):
         """Kick off the new-mesh step compile on a daemon thread; returns
         ``join() -> compile seconds`` (0.0 when disabled/skipped/failed).
 
@@ -401,7 +433,9 @@ class ElasticWorker:
         state the executable is (ideally) ready and the first step on the
         new mesh pays dispatch, not XLA. Needs the batch avals a previous
         incarnation's first placement recorded; a cold start has none and
-        compiles lazily on step 1 exactly as before.
+        compiles lazily on step 1 exactly as before. The ``warm_compile``
+        span is recorded from the compile thread so its wall interval shows
+        the overlap with ``restore`` on the stitched timeline.
         """
         import threading
 
@@ -410,9 +444,16 @@ class ElasticWorker:
             return lambda: 0.0
 
         def _compile():
+            t0 = time.time()
             try:
                 out["seconds"] = trainer.warm_compile(fresh, self._batch_avals)
+                self.tracer.record("warm_compile", t0, time.time(),
+                                   trace_id=trace_id, component="worker",
+                                   compile_seconds=out["seconds"])
             except Exception:  # edl: noqa[EDL005] warm-compile is an optimization; a failure must degrade to the lazy step-1 compile, not kill the rescale
+                self.tracer.record("warm_compile", t0, time.time(),
+                                   trace_id=trace_id, component="worker",
+                                   error="warm_compile_failed")
                 log.warning("rescale warm-compile failed; first step will "
                             "compile lazily", exc_info=True)
 
@@ -493,14 +534,62 @@ class ElasticWorker:
 
     def run(self, max_rescales: int = 32) -> Dict[str, float]:
         """Train until the task queue is exhausted, rescaling on membership
-        changes. Returns summary metrics."""
+        changes. Returns summary metrics.
+
+        With ``config.metrics_port`` set, `/metrics` + `/healthz` + `/spans`
+        are served for the run's duration (``self.metrics_url`` carries the
+        bound address — port 0 means ephemeral), with the coordinator's
+        status counters bridged onto the same scrape.
+        """
+        if self.config.metrics_port is None:
+            return self._run(max_rescales)
+        from edl_tpu.obs.bridge import CoordinatorStatusBridge
+        from edl_tpu.obs.http import MetricsServer
+
+        bridge = CoordinatorStatusBridge(self.client).register()
+        server = MetricsServer(port=self.config.metrics_port,
+                               tracer=self.tracer,
+                               health=self._health).start()
+        self.metrics_url = server.url  # edl: noqa[EDL001] set once at startup, before the serving thread handles requests
+        log.info("worker metrics at %s/metrics", server.url)
+        try:
+            return self._run(max_rescales)
+        finally:
+            bridge.unregister()
+            server.stop()
+
+    def _health(self) -> Dict:
+        return {
+            "worker": self.client.worker,
+            "epoch": self._epoch,
+            "world": self._world,
+            "rank": self._rank,
+            "steps": self.steps_done,
+            "rescales": len(self.rescales),
+        }
+
+    def _run(self, max_rescales: int) -> Dict[str, float]:
         self._sync_membership()
         t_start = time.perf_counter()
+        #: (drain_t0, ckpt_t0, ckpt_t1) measured while the OLD epoch was
+        #: draining; recorded as spans only after rendezvous settles the NEW
+        #: epoch — the rescale's trace id — so all five lifecycle phases
+        #: stitch under one correlator.
+        pending_drain = None
         while True:
             # Rendezvous: all members agree on (epoch, world) before meshes
             # are built — joiners arrive here too, so nobody waits on a ghost.
             self._rendezvous()
             world = self._world
+            rid = rescale_trace_id(self._epoch)
+            if pending_drain is not None:
+                drain_t0, ck_t0, ck_t1 = pending_drain
+                pending_drain = None
+                self.tracer.record("drain", drain_t0, ck_t0, trace_id=rid,
+                                   component="worker",
+                                   from_world=self._prev_world)
+                self.tracer.record("checkpoint", ck_t0, ck_t1, trace_id=rid,
+                                   component="worker")
             rescale_t0 = time.perf_counter()
             mesh = self._build_mesh(world)
             codec_channel = None
@@ -522,9 +611,16 @@ class ElasticWorker:
             # compiles on a background thread while orbax reshards the
             # checkpoint onto the mesh.
             fresh = trainer.init_state()
-            join_warm = self._start_warm_compile(trainer, fresh)
+            join_warm = self._start_warm_compile(trainer, fresh, trace_id=rid)
+            t_restore0 = time.time()
             state = self._restore_or_init(trainer, fresh=fresh)
+            self.tracer.record("restore", t_restore0, time.time(),
+                               trace_id=rid, component="worker", world=world)
             compile_seconds = join_warm()
+            # first_step measures mesh-ready -> first optimizer step done:
+            # the residual cost warm-compile could not hide (dispatch, any
+            # lazy compile remainder, the first batch's lease + placement).
+            mesh_ready = time.time()
             first_step_done = False
             last_ckpt_step = int(state.step)
             rescale = False
@@ -549,7 +645,13 @@ class ElasticWorker:
                         if not first_step_done:
                             first_step_done = True
                             recovery = time.perf_counter() - rescale_t0
+                            self.tracer.record(
+                                "first_step", mesh_ready, time.time(),
+                                trace_id=rid, component="worker",
+                                step=int(state.step), world=world,
+                            )
                             if self.steps_done:  # a rescale, not cold start
+                                self.obs.rescales.inc()
                                 self.rescales.append(
                                     RescaleEvent(
                                         at_step=int(state.step),
@@ -560,6 +662,7 @@ class ElasticWorker:
                                     )
                                 )
                         self.steps_done += 1
+                        self.obs.steps.inc()
                         self.losses.append(float(loss))
                         if task is not None:
                             p = split_pass(task)[1]
@@ -600,6 +703,12 @@ class ElasticWorker:
                 self._carry_consumed.extend(reader.take_consumed())
                 if reader.interrupted is not None:
                     rescale = True
+                    # Drain starts at the SIGNAL (stop_check's interrupt
+                    # decision, possibly mid-step), not at this check: the
+                    # interval covers finishing the in-flight batch and
+                    # winding the reader down.
+                    drain_t0 = self._drain_signal_t or time.time()
+                    self._drain_signal_t = 0.0
                 elif reader.exhausted:
                     finished = True
                 else:
@@ -613,13 +722,18 @@ class ElasticWorker:
                     time.sleep(0.2)
                     if self._epoch_changed(force=True):
                         rescale = True
+                        drain_t0 = self._drain_signal_t or time.time()
+                        self._drain_signal_t = 0.0
 
             if rescale:
                 # Membership changed OR the outage budget expired: make
                 # state durable first. During an outage the completions
                 # buffer in the outbox — this is exactly checkpoint-and-
                 # park, and _register_blocking below is the park.
+                ck_t0 = time.time()
                 self._checkpoint_and_commit(state, None, block=True)
+                ck_t1 = time.time()
+                pending_drain = (drain_t0, ck_t0, ck_t1)
                 if self.config.restart_on_rescale:
                     from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
 
@@ -632,6 +746,7 @@ class ElasticWorker:
                 info = self.client.register(takeover=False)
                 if not info.get("ok"):  # refresh observed epoch/world
                     self.parks += 1
+                    self.obs.parks.inc()
                     info = self._register_blocking(takeover=False)
                 self._adopt(info)
                 if len(self.rescales) >= max_rescales:

@@ -50,12 +50,13 @@ from jax.sharding import Mesh
 from edl_tpu.coordinator.client import CoordinatorAuthError, CoordinatorError
 from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.models.base import Model
+from edl_tpu.obs.instruments import WorkerInstruments
 from edl_tpu.parallel import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.elastic import ElasticConfig
 from edl_tpu.runtime.train_loop import Trainer, TrainState
 
-log = logging.getLogger("edl_tpu.multihost")
+log = logging.getLogger("edl_tpu.runtime.multihost")
 
 #: KV key template for round plans; epoch-scoping keeps incarnations apart.
 ROUND_KEY = "edl/mh_round/{epoch}/{round}"
@@ -100,6 +101,9 @@ class MultiHostWorker:
         self.config = config
         self.mesh_axes = mesh_axes
         self.profiler = profiler
+        #: same metric families as ElasticWorker — dashboards don't care
+        #: which worker flavor a pod runs.
+        self.obs = WorkerInstruments()
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.steps_done = 0
         self.losses: List[float] = []
@@ -161,8 +165,10 @@ class MultiHostWorker:
         lm_at = getattr(self.client, "last_membership_at", 0.0)
         if lm is not None and now - lm_at < self.config.heartbeat_interval:
             self.hb_coalesced += 1
+            self.obs.note_coalesced_heartbeat()
             return
-        self.client.heartbeat()  # fails soft under OutboxClient
+        self.obs.timed_heartbeat(self.client)  # fails soft under OutboxClient
+        self.obs.note_outage_state(self.client)
 
     def _build_mesh(self) -> Mesh:
         devices = jax.devices()  # global: every process's chips
@@ -442,6 +448,7 @@ class MultiHostWorker:
             self._hb_sleep()
             info = self.client.register(takeover=True)
         epoch = int(info["epoch"])
+        self.obs.note_epoch(epoch)
 
         mesh = self._build_mesh()
         codec_channel = None
@@ -511,6 +518,7 @@ class MultiHostWorker:
                 state, loss = step_fn(state, placed)
                 ran_steps += 1
                 self.steps_done += 1
+                self.obs.steps.inc()
                 self.losses.append(float(loss))
                 if self.profiler is not None:
                     self.profiler.step(samples, place_seconds=place_dt)

@@ -25,9 +25,17 @@ import optax
 from jax.sharding import Mesh
 
 from edl_tpu.models.base import Model
+from edl_tpu.obs.metrics import get_registry
 from edl_tpu.parallel.sharding import batch_shardings, shard_batch
 
-log = logging.getLogger("edl_tpu.trainer")
+log = logging.getLogger("edl_tpu.runtime.train_loop")
+
+#: retraces inside the steady loop are a performance bug wherever they
+#: happen — one process-wide counter, shared by every Trainer instance.
+_M_RETRACES = get_registry().counter(
+    "edl_trainer_retraces_total",
+    "steady-state jit recompilations (shape/dtype churn in the hot loop)",
+)
 
 
 def _aval_signature(tree: Any) -> Tuple:
@@ -712,6 +720,7 @@ class Trainer:
         self._compiles_seen = total
         if self._warmed and step > 1:
             self.retraces += grew
+            _M_RETRACES.inc(grew)
             log.warning(
                 "train step RECOMPILED at step %d (%d new program(s), "
                 "jit cache now %d) — shape/dtype churn in the input "

@@ -22,8 +22,30 @@ from edl_tpu.api.types import JobPhase
 from edl_tpu.controller.cluster import ClusterProvider
 from edl_tpu.controller.jobparser import ROLE_TRAINER
 from edl_tpu.controller.store import JobStore
+from edl_tpu.obs.metrics import get_registry
 
-log = logging.getLogger("edl_tpu.collector")
+log = logging.getLogger("edl_tpu.tools.collector")
+
+# Every sample mirrors onto the controller's /metrics endpoint: the JSONL
+# stream keeps history, the gauges carry the live values a scraper wants.
+_REG = get_registry()
+_M_SUBMITTED = _REG.gauge("edl_cluster_submitted_jobs", "jobs in the store")
+_M_PENDING = _REG.gauge(
+    "edl_cluster_pending_jobs", "submitted jobs with no running pods yet")
+_M_RUNNING = _REG.gauge("edl_cluster_running_jobs", "jobs in RUNNING phase")
+_M_UTIL = _REG.gauge(
+    "edl_cluster_utilization",
+    "cluster resource utilization fraction, by resource",
+    labelnames=("resource",),
+)
+_M_SUP_RESTARTS = _REG.gauge(
+    "edl_coordinator_supervisor_restarts",
+    "times the supervised coordinator was restarted",
+)
+_M_SUP_DOWNTIME = _REG.gauge(
+    "edl_coordinator_supervisor_downtime_seconds",
+    "cumulative seconds the supervised coordinator was down",
+)
 
 
 @dataclass
@@ -124,6 +146,16 @@ class Collector:
                 if self.supervisor is not None else {}
             ),
         )
+        _M_SUBMITTED.set(float(s.submitted_jobs))
+        _M_PENDING.set(float(s.pending_jobs))
+        _M_RUNNING.set(float(s.running_jobs))
+        _M_UTIL.set(s.cpu_utilization, resource="cpu")
+        _M_UTIL.set(s.tpu_utilization, resource="tpu")
+        _M_UTIL.set(s.memory_utilization, resource="memory")
+        if "restarts" in s.coordinator:
+            _M_SUP_RESTARTS.set(float(s.coordinator["restarts"]))
+        if "downtime_seconds" in s.coordinator:
+            _M_SUP_DOWNTIME.set(float(s.coordinator["downtime_seconds"]))
         self.samples.append(s)
         if len(self.samples) > self._max:
             del self.samples[: len(self.samples) - self._max]

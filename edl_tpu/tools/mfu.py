@@ -112,8 +112,11 @@ def mfu_fields(
         return out
     achieved = flops * steps_per_sec / max(1, n_chips) / 1e12
     peak = peak_tflops_per_chip(device)
+    rounded = round(achieved, 3)
     out.update(
-        tflops_per_sec=round(achieved, 3),
+        # never round a positive rate down to 0: CPU-sim figures for tiny
+        # models sit below a milli-TFLOP, and 0.0 reads as "no compute ran"
+        tflops_per_sec=rounded if rounded > 0 else achieved,
         peak_tflops=peak,
         mfu=round(achieved / peak, 4) if peak else None,
     )

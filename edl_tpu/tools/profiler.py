@@ -30,6 +30,8 @@ from typing import Any, Dict, Iterator, List, Optional, TextIO
 
 import jax
 
+from edl_tpu.obs.metrics import get_registry
+
 __all__ = [
     "StepProfiler",
     "StepRecord",
@@ -115,6 +117,27 @@ class StepProfiler:
         self._count = 0
         self._mark: Optional[float] = None
         self._pending_warmup = 0
+        # Registry mirrors of the per-step series: JSONL sinks carry the
+        # full history, /metrics carries the live distribution. Get-or-create
+        # means every profiler in the process feeds the same families.
+        registry = get_registry()
+        self._m_step_time = registry.histogram(
+            "edl_step_time_seconds",
+            "training step wall time, by phase (steady vs warmup/recompile)",
+            labelnames=("phase",),
+        )
+        self._m_samples = registry.counter(
+            "edl_step_samples_total", "training examples consumed",
+        )
+        self._m_place_time = registry.histogram(
+            "edl_place_time_seconds",
+            "host-side batch placement time (wire decode + H2D sharding)",
+        )
+        self._m_collective_est = registry.gauge(
+            "edl_collective_time_estimate_seconds",
+            "analytic data-plane collective-time estimate for the current "
+            "mesh/layout (a model, not a measurement)",
+        )
 
     # -- feeding ---------------------------------------------------------------
 
@@ -153,6 +176,13 @@ class StepProfiler:
                          collective_seconds=collective_seconds)
         self._count += 1
         self._mark = now
+        self._m_step_time.observe(rec.seconds,
+                                  phase="warmup" if is_warmup else "steady")
+        self._m_samples.inc(samples)
+        if place_seconds is not None:
+            self._m_place_time.observe(place_seconds)
+        if collective_seconds is not None:
+            self._m_collective_est.set(collective_seconds)
         self.records.append(rec)
         if len(self.records) > self.window:
             del self.records[: len(self.records) - self.window]
@@ -178,14 +208,28 @@ class StepProfiler:
     def summary(self) -> Dict[str, float]:
         steady = self.steady
         if not steady:
-            return {"steps": float(len(self.records)), "steady_steps": 0.0}
+            # Well-defined empty summary: same keys as the populated one,
+            # all finite zeros — a zero-step run (rescale before the first
+            # steady step, a crashed worker's flush) must aggregate cleanly,
+            # never throw or emit NaN percentiles downstream.
+            return {
+                "steps": float(self._count),
+                "steady_steps": 0.0,
+                "samples_per_sec": 0.0,
+                "step_time_mean_s": 0.0,
+                "step_time_p50_s": 0.0,
+                "step_time_p95_s": 0.0,
+                "step_time_max_s": 0.0,
+            }
         times = sorted(r.seconds for r in steady)
         total = sum(times)
         samples = sum(r.samples for r in steady)
         out = {
             "steps": float(self._count),
             "steady_steps": float(len(steady)),
-            "samples_per_sec": samples / total if total > 0 else float("inf"),
+            # total == 0 can only happen with clamped/mocked clocks; report
+            # 0 throughput rather than inf (inf is not JSON-representable).
+            "samples_per_sec": samples / total if total > 0 else 0.0,
             "step_time_mean_s": total / len(steady),
             "step_time_p50_s": _percentile(times, 0.5),
             "step_time_p95_s": _percentile(times, 0.95),

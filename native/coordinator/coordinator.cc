@@ -549,6 +549,7 @@ class Coordinator {
   long long fsyncs_ = 0;           // group-commit appends + snapshots
   long long snapshots_ = 0;        // compactions (and identity rewrites)
   long long turns_ = 0;            // event-loop wakeups
+  double boot_sec_ = now_sec();    // uptime_seconds origin (op_status)
 };
 
 // Durable state is JSON-lines so it reuses the wire parser/writer. A file is
@@ -1127,6 +1128,13 @@ std::string Coordinator::op_bump_epoch() {
 std::string Coordinator::op_status() {
   // The ops/fsyncs/turns counters let bench_coord.py measure group-commit
   // amortization (fsyncs per op, ops per event-loop turn) without strace.
+  // lease_holders rides the flat wire format as "worker=count" strings —
+  // the Python-side metrics bridge splits them back into labeled gauges.
+  std::vector<std::string> holders;
+  holders.reserve(leases_by_worker_.size());
+  for (auto& [worker, tasks] : leases_by_worker_)
+    if (!tasks.empty())
+      holders.push_back(worker + "=" + std::to_string(tasks.size()));
   return JsonWriter()
       .field("ok", true)
       .field("world", (double)members_.size())
@@ -1140,6 +1148,8 @@ std::string Coordinator::op_status() {
       .field("snapshots", (double)snapshots_)
       .field("journal_records", (double)journal_appends_)
       .field("turns", (double)turns_)
+      .field("uptime_seconds", now_sec() - boot_sec_)
+      .field("lease_holders", holders)
       .done();
 }
 

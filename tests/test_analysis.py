@@ -681,7 +681,7 @@ def test_retrace_canary_counts_recompiles(caplog):
     # a changed batch shape forces a recompile — the canary must see it
     batch = fit_a_line.MODEL.synthetic_batch(rng, 32)
     state, _ = trainer.train_step(state, trainer.place_batch(batch))
-    with caplog.at_level(logging.WARNING, logger="edl_tpu.trainer"):
+    with caplog.at_level(logging.WARNING, logger="edl_tpu.runtime.train_loop"):
         tripped = trainer.check_retrace(step=4)
     assert tripped is True
     assert trainer.retraces >= 1

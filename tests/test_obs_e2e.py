@@ -1,0 +1,136 @@
+"""Telemetry-plane acceptance test (ISSUE 9): drive a REAL elastic rescale
+while scraping the worker's live `/metrics` endpoint over HTTP.
+
+Asserts the two ends of the tentpole in one run:
+
+- the scrape parses as Prometheus text exposition (``parse_prometheus``
+  raising is a failure) and carries metric families from all three layers —
+  worker runtime, coordinator client transport, and the BRIDGED native
+  coordinator's status counters — on one page;
+- the rescale trace contains every lifecycle phase (drain, checkpoint,
+  warm_compile, restore, first_step) with strictly positive durations, all
+  under ONE shared rescale trace id, with the worker-side spans correlated
+  purely through the membership epoch.
+"""
+
+import threading
+import time
+
+from edl_tpu.coordinator import CoordinatorServer
+from edl_tpu.models import fit_a_line
+from edl_tpu.obs.http import scrape_metrics
+from edl_tpu.obs.metrics import parse_prometheus
+from edl_tpu.obs.tracing import RESCALE_PHASES, Tracer, rescale_timeline
+from edl_tpu.runtime import TrainerConfig
+from edl_tpu.runtime.data import SyntheticShardSource, shard_names
+from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+from edl_tpu.tools.profiler import StepProfiler
+
+#: at least one family per instrumented layer must appear on the one scrape.
+WORKER_FAMILIES = ("edl_worker_epoch", "edl_worker_steps_total",
+                   "edl_worker_heartbeat_latency_seconds")
+CLIENT_FAMILIES = ("edl_client_calls_total",)
+COORDINATOR_FAMILIES = ("edl_coordinator_up", "edl_coordinator_ops",
+                        "edl_coordinator_journal_records")
+
+
+def test_rescale_scraped_live_with_full_lifecycle_trace(tmp_path):
+    model = fit_a_line.MODEL
+    tracer = Tracer()
+    scrape = {"text": ""}
+    stop_flag = threading.Event()
+
+    with CoordinatorServer(task_lease_sec=60.0,
+                           heartbeat_ttl_sec=60.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks(shard_names("obs", 6))
+        cfg = ElasticConfig(
+            checkpoint_dir=str(tmp_path / "ck"),
+            checkpoint_interval=5,
+            heartbeat_interval=0.0,  # check epoch every batch
+            rescale_barrier_timeout=30.0,
+            metrics_port=0,  # embedded endpoint on an ephemeral port
+            trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+        )
+        worker = ElasticWorker(
+            model,
+            server.client("trainer-0"),
+            SyntheticShardSource(model, batch_size=32, batches_per_shard=8),
+            cfg,
+            profiler=StepProfiler(warmup=1),
+            tracer=tracer,
+        )
+
+        def scraper():
+            # keep the LAST successful scrape: the endpoint only exists while
+            # the worker runs, so success here proves scrape-during-training.
+            while not stop_flag.is_set():
+                url = getattr(worker, "metrics_url", None)
+                if url:
+                    try:
+                        scrape["text"] = scrape_metrics(url, timeout=5.0)
+                    except OSError:
+                        pass  # booting or already torn down
+                time.sleep(0.05)
+
+        def joiner():
+            # the second trainer arrives mid-run: membership event -> epoch
+            # bump -> the worker's 4->8 device rescale (test_elastic's flow).
+            while worker.steps_done < 5 and not stop_flag.is_set():
+                time.sleep(0.05)
+            c = server.client("trainer-1")
+            epoch = c.register()["epoch"]
+            while not stop_flag.is_set():
+                reply = c.sync(epoch, timeout=5.0)
+                if reply.get("ok"):
+                    break
+                epoch = reply.get("epoch", epoch)
+            while not stop_flag.is_set():
+                hb = c.heartbeat()
+                if hb.get("ok") and hb["epoch"] != epoch:
+                    epoch = hb["epoch"]
+                    c.sync(epoch, timeout=5.0)
+                time.sleep(0.3)
+
+        threads = [threading.Thread(target=scraper, daemon=True),
+                   threading.Thread(target=joiner, daemon=True)]
+        for t in threads:
+            t.start()
+        try:
+            metrics = worker.run()
+        finally:
+            stop_flag.set()
+            for t in threads:
+                t.join(timeout=10)
+
+    assert metrics["rescales"] >= 1, metrics
+
+    # -- (a) live scrape parses and carries all three layers -------------------
+    assert scrape["text"], "no successful /metrics scrape during the run"
+    families = parse_prometheus(scrape["text"])  # ValueError == malformed
+    for fam in WORKER_FAMILIES + CLIENT_FAMILIES + COORDINATOR_FAMILIES:
+        assert fam in families, (fam, sorted(families))
+    # the bridge's scrape-time status poll actually reached the coordinator
+    assert families["edl_coordinator_up"]["samples"][
+        "edl_coordinator_up"] == 1.0
+
+    # -- (b) full lifecycle under one shared rescale id -------------------------
+    timeline = rescale_timeline(tracer.spans)
+    complete = {
+        tid: t for tid, t in timeline.items()
+        if all(p in t["phases"] for p in RESCALE_PHASES)
+    }
+    assert complete, {tid: sorted(t["phases"]) for tid, t in timeline.items()}
+    tid, t = sorted(complete.items())[-1]  # latest epoch = the rescale
+    for phase in RESCALE_PHASES:
+        assert t["phases"][phase]["seconds"] > 0.0, (phase, t)
+        assert t["phases"][phase]["component"] == "worker"
+    # phases of ONE rescale nest inside its wall interval
+    assert t["wall_seconds"] > 0.0
+    assert t["span_count"] >= len(RESCALE_PHASES)
+    # warm_compile deliberately overlaps restore (it runs on a background
+    # thread); both must still start after the checkpoint that drained.
+    assert t["phases"]["warm_compile"]["start"] >= \
+        t["phases"]["checkpoint"]["start"]
+    assert t["phases"]["first_step"]["end"] >= \
+        t["phases"]["restore"]["end"]
