@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py
 
-.PHONY: analyze analyze-json baseline test chaos lint obs-smoke bench-pipeline bench-coord bench-collective
+.PHONY: analyze analyze-json baseline test chaos lint obs-smoke tsan-smoke verify bench-pipeline bench-coord bench-collective
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -32,6 +32,24 @@ chaos:
 ## coordinator) is present. See doc/observability.md.
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.obs
+
+## Native race gate: rebuild the coordinator under ThreadSanitizer and rerun
+## the sanitizer-marked lane (chaos/outage/batch/hammer tests) against it.
+## EDL_COORD_SANITIZER=tsan makes every CoordinatorServer in the run spawn
+## the instrumented binary; a TSan report fails the child (exitcode=66) and
+## the tests assert sanitizer_report() is clean. Skips cleanly when no C++
+## toolchain is installed.
+tsan-smoke:
+	@if ! command -v $${CXX:-g++} >/dev/null 2>&1; then \
+		echo "tsan-smoke: no C++ toolchain ($${CXX:-g++} not found) — skipping"; \
+	else \
+		EDL_COORD_SANITIZER=tsan JAX_PLATFORMS=cpu \
+			$(PYTHON) -m pytest tests/ -q -m 'sanitizer and not slow'; \
+	fi
+
+## Everything a PR must pass: static analysis (EDL001-EDL007 vs baseline +
+## protocol_schema.json ratchet), tier-1 tests, TSan lane.
+verify: analyze test tsan-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

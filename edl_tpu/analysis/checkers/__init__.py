@@ -7,6 +7,8 @@ from edl_tpu.analysis.checkers.sharding_consistency import (
 )
 from edl_tpu.analysis.checkers.blocking import BlockingInLockChecker
 from edl_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
+from edl_tpu.analysis.checkers.thread_races import ThreadRaceChecker
+from edl_tpu.analysis.checkers.wire_protocol import WireProtocolChecker
 
 ALL_CHECKERS = (
     LockDisciplineChecker,
@@ -14,6 +16,8 @@ ALL_CHECKERS = (
     ShardingConsistencyChecker,
     BlockingInLockChecker,
     ExceptionHygieneChecker,
+    ThreadRaceChecker,
+    WireProtocolChecker,
 )
 
 RULES = {c.rule: c for c in ALL_CHECKERS}

@@ -73,7 +73,51 @@ def _build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="print the rule catalog and exit",
     )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        metavar="N",
+        help=(
+            "worker processes for the per-file phase (default: auto — "
+            "EDL_ANALYZE_JOBS, else one per core, serial for small trees)"
+        ),
+    )
+    parser.add_argument(
+        "--timings",
+        action="store_true",
+        help="print per-rule wall-clock seconds after the summary",
+    )
+    parser.add_argument(
+        "--write-protocol",
+        action="store_true",
+        help=(
+            "re-extract the native wire schema into protocol_schema.json "
+            "(the EDL007 ratchet artifact) and exit 0"
+        ),
+    )
     return parser
+
+
+def _write_protocol(root: str) -> int:
+    from edl_tpu.analysis.checkers.wire_protocol import (
+        DEFAULT_SCHEMA_NAME,
+        load_native_schema,
+    )
+
+    schema, native_rel = load_native_schema(root, {})
+    if schema is None:
+        print(f"error: {native_rel} not found under {root}", file=sys.stderr)
+        return 2
+    target = os.path.join(root, DEFAULT_SCHEMA_NAME)
+    with open(target, "w", encoding="utf-8") as f:
+        json.dump(schema, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(
+        f"wrote {target}: {len(schema['ops'])} op(s) extracted from "
+        f"{native_rel}"
+    )
+    return 0
 
 
 def _list_rules() -> int:
@@ -95,7 +139,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         else None
     )
     root = detect_root(args.paths)
-    report = analyze(args.paths, root=root, rules=rules)
+    if args.write_protocol:
+        return _write_protocol(root)
+    report = analyze(args.paths, root=root, rules=rules, jobs=args.jobs)
 
     baseline_path = args.baseline
     if baseline_path is None:
@@ -137,6 +183,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "baselined": len(accepted),
                 "suppressed": len(report.suppressed),
                 "files": report.files_checked,
+                "jobs": report.jobs,
+                "timings": {
+                    r: round(s, 4) for r, s in sorted(report.timings.items())
+                },
                 "parse_errors": [
                     {"path": p, "error": e} for p, e in report.parse_errors
                 ],
@@ -162,6 +212,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{len(report.suppressed)} suppressed finding(s) across "
             f"{report.files_checked} file(s)"
         )
+        if args.timings:
+            for rule, sec in sorted(report.timings.items()):
+                print(f"  {rule}: {sec:.3f}s")
+            print(f"  jobs: {report.jobs}")
 
     if report.parse_errors:
         return 2

@@ -371,7 +371,7 @@ class Autoscaler:
             for s in elastic
             if diff.get(s.name, 0) != 0
         }
-        self.last_plan = dict(target)
+        self.last_plan = dict(target)  # edl: noqa[EDL006] atomic reference swap under the GIL; observers (CLI/status) read the previous complete plan or the new one, never a partial dict
         _M_PLAN_JOBS.set(float(len(target)))
         if target:
             log.info("scaling plan: %s (%s)", target, reason)

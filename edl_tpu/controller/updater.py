@@ -86,7 +86,7 @@ class JobUpdater:
         return self
 
     def notify_update(self, job: TrainingJob) -> None:
-        self.job.spec = job.spec  # edl: noqa[EDL001] atomic reference swap under the GIL; the actor thread reads it on its next tick
+        self.job.spec = job.spec  # edl: noqa[EDL001,EDL006] atomic reference swap under the GIL; the actor thread reads it on its next tick
         self._enqueue("update")
 
     def record_scale(self, record) -> None:

@@ -11,6 +11,7 @@ Condition instead of a parked socket.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from collections import deque
@@ -43,6 +44,12 @@ class InProcessCoordinator:
         self._sync_arrived: Set[str] = set()
         self._sync_generation = 0
         self._kv: Dict[str, str] = {}
+        # Native-parity status counters. fsync/snapshot/journal counters stay
+        # zero (there is no journal in-process) but the fields must exist so
+        # status replies are field-identical across backends (EDL007).
+        self._ops_count = 0
+        self._batch_frames = 0
+        self._batch_subops = 0
 
     # -- expiry ---------------------------------------------------------------
 
@@ -91,6 +98,11 @@ class InProcessCoordinator:
     def register(self, worker: str, takeover: bool = False) -> Dict:
         with self._lock:
             self._tick()
+            if not worker:
+                # Same refusal as the native op_register: an anonymous member
+                # could never be ranked or dropped.
+                return {"ok": False, "error": "worker required",
+                        "epoch": self._epoch}
             if takeover:
                 # Incarnation boundary: leases held under this name belong
                 # to a dead predecessor (same pod name, warm-restarted);
@@ -315,19 +327,33 @@ class InProcessCoordinator:
 
     def kv_incr(self, key: str, delta: int = 1,
                 op_id: Optional[str] = None) -> int:
-        """Atomic counter (matches the C++ op_kv_incr): read-modify-write
-        under the lock, so concurrent failure-count bumps cannot be lost.
-        ``op_id`` dedups replayed increments exactly-once (native parity:
-        the marker lives in the KV namespace)."""
+        reply = self.kv_incr_reply(key, delta, op_id=op_id)
+        if not reply["ok"]:
+            raise ValueError(reply["error"])
+        return int(reply["value"])
+
+    def kv_incr_reply(self, key: str, delta: int = 1,
+                      op_id: Optional[str] = None) -> Dict:
+        """Atomic counter with the full native op_kv_incr reply surface:
+        read-modify-write under the lock, so concurrent failure-count bumps
+        cannot be lost; ``op_id`` dedups replayed increments exactly-once
+        (native parity: the marker lives in the KV namespace) and a replay
+        reports ``duplicate`` alongside the previously-returned value."""
         with self._lock:
+            if not key:
+                return {"ok": False, "error": "key required"}
             marker = f"__edl_op/{op_id}" if op_id else None
             if marker and marker in self._kv:
-                return int(self._kv[marker])
-            cur = int(self._kv.get(key, "0") or "0") + int(delta)
+                return {"ok": True, "value": int(self._kv[marker]),
+                        "duplicate": True}
+            try:
+                cur = int(self._kv.get(key, "0") or "0") + int(delta)
+            except ValueError:
+                return {"ok": False, "error": "value not an integer"}
             self._kv[key] = str(cur)
             if marker:
                 self._kv[marker] = str(cur)
-            return cur
+            return {"ok": True, "value": cur}
 
     def status(self) -> Dict:
         with self._lock:
@@ -342,6 +368,16 @@ class InProcessCoordinator:
                 "queued": len(self._todo),
                 "leased": len(self._leased),
                 "done": len(self._done),
+                # Wire-parity counters: ops/batch counts are real; the
+                # journal trio is structurally zero (no disk in-process) and
+                # "turns" mirrors ops — every op is its own event-loop turn.
+                "ops": self._ops_count,
+                "batch_frames": self._batch_frames,
+                "batch_subops": self._batch_subops,
+                "fsyncs": 0,
+                "snapshots": 0,
+                "journal_records": 0,
+                "turns": self._ops_count,
                 "uptime_seconds": time.monotonic() - self._boot_monotonic,
                 # native-parity encoding: flat "worker=count" strings (the
                 # wire writer has no nested objects, so neither do we).
@@ -352,6 +388,18 @@ class InProcessCoordinator:
 
     def ping(self) -> bool:
         return True
+
+    def queued_count(self) -> int:
+        with self._lock:
+            return len(self._todo)
+
+    def note_batch(self, subops: int) -> None:
+        """Batch-frame accounting from the client shim (native parity: the
+        server counts frames/sub-ops itself; in-process the framing lives in
+        InProcessClient.call_batch, so it reports here)."""
+        with self._lock:
+            self._batch_frames += 1
+            self._batch_subops += subops
 
     # -- client-compatible facade ---------------------------------------------
 
@@ -366,6 +414,8 @@ class InProcessCoordinator:
 
     def authorize(self, token: str) -> None:
         """The wire twin's auth gate (native: coordinator.cc handle())."""
+        with self._lock:
+            self._ops_count += 1
         if self.auth_token and token != self.auth_token:
             from edl_tpu.coordinator.client import CoordinatorAuthError
 
@@ -488,36 +538,78 @@ class InProcessClient:
         self._auth()
         return self._c.kv_incr(key, delta)
 
+    def _stamp(self, reply):
+        """Mirror of the native handle()'s stamp_epoch: every reply carries
+        the membership epoch, so clients coalesce epoch observation off any
+        traffic (wire parity: EDL007 checks both sides stamp)."""
+        reply = dict(reply)
+        reply.setdefault("epoch", self._c.epoch())
+        return reply
+
     def call(self, op, timeout=None, **fields):
-        """Minimal wire-call shim for callers that speak raw ops (the
-        outbox replays through this); in-process calls never fail."""
+        """Wire-call shim covering the native dispatch table op-for-op (the
+        outbox replays through this); replies are field-identical to the
+        C++ server's, including the epoch stamp — EDL007 diffs them."""
+        if op == "ping":  # native parity: ping bypasses the token gate
+            return self._stamp({"ok": True, "pong": True})
         self._auth()
-        if op == "complete_task":
-            return self._c.complete_task(self.worker, fields["task"])
-        if op == "fail_task":
-            return self._c.fail_task(self.worker, fields["task"])
-        if op == "kv_put":
-            self._c.kv_put(fields["key"], fields["value"])
-            return {"ok": True}
-        if op == "kv_incr":
-            value = self._c.kv_incr(fields["key"], fields.get("delta", 1),
-                                    op_id=fields.get("op_id"))
-            return {"ok": True, "value": value}
+        if op == "register":
+            return self._note_reply(self._c.register(
+                self.worker, takeover=bool(fields.get("takeover"))))
         if op == "heartbeat":
             return self._note_reply(self._c.heartbeat(self.worker))
+        if op == "leave":
+            return self._c.leave(self.worker)
+        if op == "members":
+            return self._stamp({"ok": True, "members": self._c.members()})
+        if op == "complete_task":
+            return self._stamp(self._c.complete_task(self.worker, fields["task"]))
+        if op == "fail_task":
+            return self._stamp(self._c.fail_task(self.worker, fields["task"]))
+        if op == "kv_put":
+            if not fields.get("key"):
+                return self._stamp({"ok": False, "error": "key required"})
+            self._c.kv_put(fields["key"], fields["value"])
+            return self._stamp({"ok": True})
+        if op == "kv_incr":
+            return self._stamp(self._c.kv_incr_reply(
+                fields.get("key", ""), fields.get("delta", 1),
+                op_id=fields.get("op_id")))
         if op == "kv_get":
-            return {"ok": True, "value": self._c.kv_get(fields["key"])}
+            return self._stamp(
+                {"ok": True, "value": self._c.kv_get(fields["key"])})
         if op == "kv_del":
             self._c.kv_del(fields["key"])
-            return {"ok": True}
+            return self._stamp({"ok": True})
         if op == "acquire_task":
-            return self._c.acquire(self.worker, req_id=fields.get("req_id"))
+            return self._stamp(
+                self._c.acquire(self.worker, req_id=fields.get("req_id")))
         if op == "add_tasks":
-            return {"ok": True, "added": self._c.add_tasks(fields["tasks"])}
+            tasks = fields.get("tasks")
+            if not isinstance(tasks, list):
+                return self._stamp(
+                    {"ok": False, "error": "tasks array required"})
+            added = self._c.add_tasks(tasks)
+            queued = self._c.queued_count()
+            return self._stamp({"ok": True, "added": added, "queued": queued})
+        if op == "barrier":
+            return self._stamp(self._c.barrier(
+                self.worker, fields["name"], int(fields["count"]),
+                timeout if timeout is not None else 120.0))
+        if op == "sync":
+            return self._stamp(self._c.sync(
+                self.worker, int(fields["epoch"]),
+                timeout if timeout is not None else 60.0))
+        if op == "bump_epoch":
+            return self._c.bump_epoch()
         if op == "status":
             return self._c.status()
-        if op == "ping":
-            return {"ok": True, "pong": True}
+        if op == "batch":
+            ops_arg = fields.get("ops")
+            if not isinstance(ops_arg, list):
+                return self._stamp({"ok": False, "error": "ops array required"})
+            return self._stamp(
+                {"ok": True, "replies": self.call_batch(ops_arg, timeout=timeout)})
         raise ValueError(f"unsupported in-process op {op!r}")
 
     def call_batch(self, ops, timeout=None):
@@ -525,10 +617,19 @@ class InProcessClient:
         per-sub-op reply list, driven through the shim — so the outbox's
         batched replay and worker piggyback paths run identically against
         the hermetic twin. Sub-op semantics (dedup ids, idempotence) are
-        the coordinator's own; framing adds nothing in-process."""
+        the coordinator's own; framing adds nothing in-process. Accepts the
+        wire encoding too (JSON strings with an "op" key)."""
+        self._c.note_batch(len(ops))
         replies = []
         for item in ops:
-            if isinstance(item, dict):
+            if isinstance(item, str):
+                try:
+                    fields = json.loads(item)
+                except (ValueError, TypeError):
+                    replies.append({"ok": False, "error": "bad json"})
+                    continue
+                op = fields.pop("op", "")
+            elif isinstance(item, dict):
                 fields = dict(item)
                 op = fields.pop("op", "")
             else:

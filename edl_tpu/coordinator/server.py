@@ -11,6 +11,7 @@ from __future__ import annotations
 import os
 import socket
 import subprocess
+import tempfile
 import threading
 import time
 from typing import Dict, Optional
@@ -21,25 +22,51 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 NATIVE_DIR = os.path.join(_REPO_ROOT, "native", "coordinator")
 BINARY = os.path.join(NATIVE_DIR, "edl-coordinator")
 
+#: EDL_COORD_SANITIZER -> (make target, binary name). The sanitizer pytest
+#: lane sets the env var so every CoordinatorServer in the process — chaos
+#: proxies, supervisors, batch tests — runs against the instrumented binary.
+SANITIZER_VARIANTS: Dict[str, str] = {
+    "": "edl-coordinator",
+    "tsan": "edl-coordinator-tsan",
+    "asan": "edl-coordinator-asan",
+}
 
-def ensure_built(timeout: float = 120.0) -> str:
-    """Build the coordinator binary; returns its path.
+
+def sanitizer_variant() -> str:
+    """Active sanitizer variant ('' when none) from EDL_COORD_SANITIZER."""
+    variant = os.environ.get("EDL_COORD_SANITIZER", "").strip().lower()
+    if variant not in SANITIZER_VARIANTS:
+        raise CoordinatorError(
+            f"EDL_COORD_SANITIZER={variant!r} — expected one of "
+            f"{sorted(SANITIZER_VARIANTS)}"
+        )
+    return variant
+
+
+def ensure_built(timeout: float = 120.0, variant: Optional[str] = None) -> str:
+    """Build the coordinator binary (the ``variant``'s, default from
+    EDL_COORD_SANITIZER); returns its path.
 
     Always invokes make — it no-ops in milliseconds when the binary is fresh,
     and rebuilds after source edits (a stale-binary check by existence alone
     would silently keep old protocol semantics live).
     """
+    if variant is None:
+        variant = sanitizer_variant()
+    name = SANITIZER_VARIANTS[variant]
+    binary = os.path.join(NATIVE_DIR, name)
     proc = subprocess.run(
-        ["make", "-C", NATIVE_DIR],
+        ["make", "-C", NATIVE_DIR, name],
         capture_output=True,
         text=True,
         timeout=timeout,
     )
-    if proc.returncode != 0 or not os.path.exists(BINARY):
+    if proc.returncode != 0 or not os.path.exists(binary):
         raise CoordinatorError(
-            f"failed to build coordinator: {proc.stdout}\n{proc.stderr}"
+            f"failed to build coordinator ({name}): "
+            f"{proc.stdout}\n{proc.stderr}"
         )
-    return BINARY
+    return binary
 
 
 def free_port() -> int:
@@ -81,6 +108,10 @@ class CoordinatorServer:
         self.auth_token = auth_token if auth_token is not None \
             else os.environ.get("EDL_COORD_TOKEN", "")
         self._proc: Optional[subprocess.Popen] = None
+        self._stderr_path: Optional[str] = None
+        #: stderr of the last exited/stopped process (sanitizer reports live
+        #: here after stop()) — capped, never None.
+        self.last_stderr: str = ""
 
     @property
     def address(self) -> str:
@@ -107,12 +138,25 @@ class CoordinatorServer:
             env["EDL_COORD_TOKEN"] = self.auth_token
         else:
             env.pop("EDL_COORD_TOKEN", None)
-        self._proc = subprocess.Popen(
-            argv,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            env=env,
+        # Sanitizer runs must fail loudly: a distinct exit code separates
+        # "TSan/ASan found something" from crashes the chaos tests inject.
+        env.setdefault("TSAN_OPTIONS", "exitcode=66")
+        env.setdefault("ASAN_OPTIONS", "exitcode=66")
+        env.setdefault("UBSAN_OPTIONS", "print_stacktrace=1")
+        # stderr goes to a file, not DEVNULL: sanitizer reports (and crash
+        # diagnostics) must survive the process; sanitizer_report() reads it.
+        fd, self._stderr_path = tempfile.mkstemp(
+            prefix="edl-coordinator-", suffix=".stderr"
         )
+        try:
+            self._proc = subprocess.Popen(
+                argv,
+                stdout=subprocess.DEVNULL,
+                stderr=fd,
+                env=env,
+            )
+        finally:
+            os.close(fd)
         deadline = time.monotonic() + wait
         while time.monotonic() < deadline:
             try:
@@ -124,10 +168,43 @@ class CoordinatorServer:
             if self._proc.poll() is not None:
                 rc = self._proc.returncode
                 self._proc = None
-                raise CoordinatorError(f"coordinator exited at startup (rc={rc})")
+                self._harvest_stderr()
+                raise CoordinatorError(
+                    f"coordinator exited at startup (rc={rc}): "
+                    f"{self.last_stderr[-500:]}"
+                )
             time.sleep(0.05)
         self.stop()  # don't leak the subprocess (and its port) on timeout
         raise CoordinatorError("coordinator did not become ready")
+
+    def _harvest_stderr(self) -> None:
+        """Fold the child's stderr file into ``last_stderr`` (capped) and
+        remove it — no temp-file leaks across chaos restarts."""
+        if self._stderr_path is None:
+            return
+        try:
+            with open(self._stderr_path, "r", errors="replace") as f:
+                # Accumulate across restarts: a sanitizer report from an
+                # earlier incarnation must survive a supervisor's respawn.
+                self.last_stderr = (self.last_stderr + f.read())[-65536:]
+        except OSError:
+            pass
+        try:
+            os.unlink(self._stderr_path)
+        except OSError:
+            pass
+        self._stderr_path = None
+
+    def sanitizer_report(self) -> str:
+        """Stderr of the running process (or of the last one after stop) —
+        where TSan/ASan write their reports. Empty string when clean."""
+        if self._stderr_path is not None:
+            try:
+                with open(self._stderr_path, "r", errors="replace") as f:
+                    return (self.last_stderr + f.read())[-65536:]
+            except OSError:
+                return self.last_stderr
+        return self.last_stderr
 
     def poll(self) -> Optional[int]:
         """None while the coordinator process runs; its exit code otherwise."""
@@ -148,6 +225,7 @@ class CoordinatorServer:
             self._proc.kill()
             self._proc.wait()
             self._proc = None
+        self._harvest_stderr()
 
     def stop(self) -> None:
         if self._proc is not None:
@@ -158,6 +236,7 @@ class CoordinatorServer:
                 self._proc.kill()
                 self._proc.wait()
             self._proc = None
+        self._harvest_stderr()
 
     def restart(self, wait: float = 10.0) -> "CoordinatorServer":
         """Bring a dead (or killed) coordinator back on the SAME port with

@@ -110,6 +110,9 @@ class Collector:
         self.supervisor = supervisor
         self.samples: List[ClusterSample] = []
         self._max = max_samples
+        #: guards the samples ring: sample() runs on the collector thread,
+        #: but tests and report code call it (and the readers) directly.
+        self._samples_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -156,9 +159,10 @@ class Collector:
             _M_SUP_RESTARTS.set(float(s.coordinator["restarts"]))
         if "downtime_seconds" in s.coordinator:
             _M_SUP_DOWNTIME.set(float(s.coordinator["downtime_seconds"]))
-        self.samples.append(s)
-        if len(self.samples) > self._max:
-            del self.samples[: len(self.samples) - self._max]
+        with self._samples_lock:
+            self.samples.append(s)
+            if len(self.samples) > self._max:
+                del self.samples[: len(self.samples) - self._max]
         if self.sink is not None:
             self.sink.write(json.dumps(s.to_dict()) + "\n")
             self.sink.flush()
@@ -167,7 +171,8 @@ class Collector:
     # -- loop ------------------------------------------------------------------
 
     def start(self) -> "Collector":
-        self._thread = threading.Thread(target=self._run, name="edl-collector", daemon=True)
+        self._thread = threading.Thread(target=self._run, name="edl-collector", daemon=True)  # edl: noqa[EDL001] started exactly once before the collector is shared; _samples_lock guards the ring, not lifecycle
+
         self._thread.start()
         return self
 
@@ -187,7 +192,9 @@ class Collector:
     # -- summaries the experiment report needs ---------------------------------
 
     def peak_tpu_utilization(self) -> float:
-        return max((s.tpu_utilization for s in self.samples), default=0.0)
+        with self._samples_lock:
+            return max((s.tpu_utilization for s in self.samples), default=0.0)
 
     def latest(self) -> Optional[ClusterSample]:
-        return self.samples[-1] if self.samples else None
+        with self._samples_lock:
+            return self.samples[-1] if self.samples else None

@@ -612,11 +612,12 @@ def test_cli_parse_error_exits_two(tmp_path, capsys):
     assert rc == 2
 
 
-def test_cli_list_rules_names_all_five(capsys):
+def test_cli_list_rules_names_all_seven(capsys):
     rc = cli_main(["--list-rules"])
     out = capsys.readouterr().out
     assert rc == 0
-    for rule in ("EDL001", "EDL002", "EDL003", "EDL004", "EDL005"):
+    for rule in ("EDL001", "EDL002", "EDL003", "EDL004", "EDL005",
+                 "EDL006", "EDL007"):
         assert rule in out
 
 
@@ -686,3 +687,504 @@ def test_retrace_canary_counts_recompiles(caplog):
     assert tripped is True
     assert trainer.retraces >= 1
     assert any("RECOMPILED" in r.message for r in caplog.records)
+
+
+# -- EDL006: cross-root lockset races -----------------------------------------
+
+
+
+def test_edl006_flags_attr_written_from_two_roots_without_lock(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.count += 1
+
+            def bump(self):
+                self.count += 1
+        """,
+        ["EDL006"],
+    )
+    assert rules_of(report) == ["EDL006"]
+    assert "Worker.count" in report.findings[0].message
+    assert "no common lock" in report.findings[0].message
+
+
+def test_edl006_accepts_common_lock_on_both_roots(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._lock:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+        """,
+        ["EDL006"],
+    )
+    assert report.findings == []
+
+
+def test_edl006_single_root_and_init_writes_are_clean(tmp_path):
+    # __init__ publishes before the thread starts; only one root writes after.
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.count += 1
+
+            def read(self):
+                return 1
+        """,
+        ["EDL006"],
+    )
+    assert report.findings == []
+
+
+def test_edl006_condition_aliases_its_wrapped_lock(tmp_path):
+    # Condition(self._lock) and self._lock are the SAME mutex: one root
+    # holding the condition and the other the raw lock is race-free.
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                with self._cv:
+                    self.count += 1
+
+            def bump(self):
+                with self._lock:
+                    self.count += 1
+        """,
+        ["EDL006"],
+    )
+    assert report.findings == []
+
+
+def test_edl006_lockset_propagates_through_call_chain(tmp_path):
+    # Both roots take the lock BEFORE calling the shared helper: the helper's
+    # entry lockset (meet over callers) carries the guard to the write.
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+
+            def start(self):
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    self._bump()
+
+            def grow(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self.x += 1
+        """,
+        ["EDL006"],
+    )
+    assert report.findings == []
+
+
+def test_edl006_unlocked_caller_poisons_helper_lockset(tmp_path):
+    # One caller forgets the lock: the meet at _bump's entry goes empty and
+    # the write is flagged even though the OTHER root locked correctly.
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.x = 0
+
+            def start(self):
+                threading.Thread(target=self.run).start()
+
+            def run(self):
+                with self._lock:
+                    self._bump()
+
+            def grow(self):
+                self._bump()
+
+            def _bump(self):
+                self.x += 1
+        """,
+        ["EDL006"],
+    )
+    assert rules_of(report) == ["EDL006"]
+
+
+def test_edl006_http_handler_methods_are_thread_roots(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.hits = self.hits + 1
+
+            def reset(self):
+                self.hits = 0
+        """,
+        ["EDL006"],
+    )
+    assert rules_of(report) == ["EDL006"]
+    assert "Handler.hits" in report.findings[0].message
+
+
+def test_edl006_collector_callback_is_a_thread_root(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        class Probe:
+            def __init__(self):
+                self.last = None
+
+            def attach(self, reg):
+                reg.register_collector(self._collect)
+
+            def _collect(self):
+                self.last = 1
+
+            def poll(self):
+                self.last = 2
+        """,
+        ["EDL006"],
+    )
+    assert rules_of(report) == ["EDL006"]
+
+
+def test_edl006_noqa_on_anchor_line_suppresses(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run).start()
+
+            def _run(self):
+                self.count += 1  # edl: noqa[EDL006] GIL-atomic int bump, drift tolerated
+
+            def bump(self):
+                self.count += 1
+        """,
+        ["EDL006"],
+    )
+    assert report.findings == []
+    assert len(report.suppressed) == 1 and report.suppressed[0].rule == "EDL006"
+
+
+# -- EDL007: wire-protocol conformance ----------------------------------------
+
+from edl_tpu.analysis.checkers.wire_protocol import (  # noqa: E402
+    extract_native_schema,
+)
+
+_TOY_CC = """
+// Toy coordinator: dispatch table + handlers, for EDL007 fixtures.
+
+Json Coordinator::membership_reply() {
+  Json r;
+  r.field("ok");
+  r.field("rank");
+  return r;
+}
+
+Json Coordinator::op_join(const Json& req) {
+  get_str(req, "name");
+  return membership_reply();
+}
+
+Json Coordinator::op_put(const Json& req) {
+  get_str(req, "key");
+  get_str(req, "value");
+  Json r;
+  r.field("ok");
+  return r;
+}
+
+Json Coordinator::dispatch(const Json& req) {
+  std::string op = get_str(req, "op");
+  if (op == "join") return op_join(req);
+  if (op == "put") return op_put(req);
+  if (op == "ping") return Json().field("ok", true);
+  return err();
+}
+
+Json Coordinator::handle(const Json& req) {
+  Json reply = dispatch(req);
+  stamp_epoch(dispatch, reply);
+  return reply;
+}
+"""
+
+_EDL007_CONFIG = {
+    "edl007_native_source": "coord.cc",
+    "edl007_schema": "schema.json",
+    "edl007_prefixes": [""],  # every analyzed .py speaks the protocol
+}
+
+
+def _toy_schema():
+    return extract_native_schema(textwrap.dedent(_TOY_CC), "coord.cc")
+
+
+def wire_check(tmp_path, py_files, cc=_TOY_CC, schema="fresh"):
+    """Analyze a toy cross-language pair: ``coord.cc`` + python files, with
+    the committed-schema artifact either up to date ('fresh'), absent
+    (None), or an explicit dict."""
+    (tmp_path / "coord.cc").write_text(textwrap.dedent(cc))
+    if schema == "fresh":
+        schema = extract_native_schema(textwrap.dedent(cc), "coord.cc")
+    if schema is not None:
+        (tmp_path / "schema.json").write_text(json.dumps(schema))
+    paths = []
+    for name, src in py_files.items():
+        p = tmp_path / name
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    return analyze(
+        paths, root=str(tmp_path), rules=["EDL007"], config=_EDL007_CONFIG
+    )
+
+
+def test_edl007_extracts_dispatch_table_from_cc():
+    schema = _toy_schema()
+    assert set(schema["ops"]) == {"join", "put", "ping"}
+    assert schema["epoch_stamped"] is True
+    assert schema["unstamped_deferred_ops"] == []
+    # helper expansion (membership_reply) + the implicit epoch stamp
+    assert schema["ops"]["join"]["request"] == ["name"]
+    assert schema["ops"]["join"]["reply"] == ["epoch", "ok", "rank"]
+    assert schema["ops"]["put"]["request"] == ["key", "value"]
+    # inline arm (ping): no handler function, fields from the return stmt
+    assert schema["ops"]["ping"]["reply"] == ["epoch", "ok"]
+
+
+def test_edl007_comments_do_not_leak_into_schema():
+    cc = _TOY_CC + """
+// if (op == "ghost") return op_ghost(req);
+/* r.field("phantom"); deferred_ */
+"""
+    schema = extract_native_schema(textwrap.dedent(cc), "coord.cc")
+    assert "ghost" not in schema["ops"]
+    assert all("phantom" not in s["reply"] for s in schema["ops"].values())
+
+
+def test_edl007_conformant_pair_is_clean(tmp_path):
+    report = wire_check(
+        tmp_path,
+        {
+            "client.py": """
+            class Client:
+                def join(self):
+                    return self._t.call("join", name="w0")
+
+                def put(self):
+                    return self._t.call("put", key="k", value="v")
+            """,
+        },
+    )
+    assert report.findings == []
+
+
+def test_edl007_flags_unknown_op_and_unread_field(tmp_path):
+    report = wire_check(
+        tmp_path,
+        {
+            "client.py": """
+            class Client:
+                def join(self):
+                    return self._t.call("jion", name="w0")
+
+                def put(self):
+                    return self._t.call("put", key="k", value="v", mode="fast")
+            """,
+        },
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 2
+    assert "call('jion') is not in the native dispatch table" in msgs[0]
+    assert "never reads: mode" in msgs[1]
+
+
+def test_edl007_missing_schema_artifact_is_a_finding(tmp_path):
+    report = wire_check(
+        tmp_path, {"client.py": "X = 1\n"}, schema=None
+    )
+    assert len(report.findings) == 1
+    assert "run --write-protocol" in report.findings[0].message
+
+
+def test_edl007_schema_drift_is_ratcheted(tmp_path):
+    stale = _toy_schema()
+    del stale["ops"]["put"]
+    report = wire_check(tmp_path, {"client.py": "X = 1\n"}, schema=stale)
+    assert len(report.findings) == 1
+    f = report.findings[0]
+    assert "op 'put' is in the dispatch table but not in" in f.message
+    assert "run --write-protocol" in f.message and f.symbol == "put"
+
+
+def test_edl007_deferred_reply_must_carry_epoch():
+    cc = _TOY_CC.replace(
+        'if (op == "join")',
+        'if (op == "wait") { op_wait(req, fd); return Json(); }\n'
+        '  if (op == "join")',
+    ).replace(
+        "Json Coordinator::dispatch",
+        """void Coordinator::op_wait(const Json& req, int fd) {
+  deferred_.push_back(fd);
+}
+
+Json Coordinator::dispatch""",
+    )
+    schema = extract_native_schema(textwrap.dedent(cc), "coord.cc")
+    assert schema["ops"]["wait"]["deferred"] is True
+    assert schema["unstamped_deferred_ops"] == ["wait"]
+
+
+def test_edl007_shim_missing_op_and_reply_drift(tmp_path):
+    report = wire_check(
+        tmp_path,
+        {
+            "inproc.py": """
+            class InProcessClient:
+                def call(self, op, timeout=None, **fields):
+                    if op == "ping":
+                        return self._stamp({"ok": True})
+                    if op == "join":
+                        return self._stamp({"ok": True})
+                    raise ValueError(op)
+            """,
+        },
+    )
+    msgs = sorted(f.message for f in report.findings)
+    assert len(msgs) == 2
+    assert "does not handle op 'put'" in msgs[0]
+    assert "in-process reply for 'join' diverges" in msgs[1]
+    assert "missing: rank" in msgs[1]
+
+
+def test_edl007_shim_covering_all_ops_is_clean(tmp_path):
+    report = wire_check(
+        tmp_path,
+        {
+            "inproc.py": """
+            class InProcessClient:
+                def call(self, op, timeout=None, **fields):
+                    if op == "ping":
+                        return self._stamp({"ok": True})
+                    if op == "join":
+                        return self._stamp({"ok": True, "rank": 0})
+                    if op == "put":
+                        return self._stamp({"ok": True})
+                    raise ValueError(op)
+            """,
+        },
+    )
+    assert report.findings == []
+
+
+def test_write_protocol_cli_round_trip(tmp_path, monkeypatch, capsys):
+    native = tmp_path / "native" / "coordinator"
+    native.mkdir(parents=True)
+    (native / "coordinator.cc").write_text(textwrap.dedent(_TOY_CC))
+    monkeypatch.chdir(tmp_path)
+    rc = cli_main(["--write-protocol"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "3 op(s)" in out
+    written = json.loads((tmp_path / "protocol_schema.json").read_text())
+    assert written == extract_native_schema(
+        textwrap.dedent(_TOY_CC), "native/coordinator/coordinator.cc"
+    )
+
+
+def test_repo_protocol_schema_matches_native_source():
+    """The committed artifact IS the extraction of the committed .cc — the
+    ratchet's premise. Fails whenever one is edited without the other."""
+    cc = (REPO_ROOT / "native" / "coordinator" / "coordinator.cc").read_text()
+    committed = json.loads((REPO_ROOT / "protocol_schema.json").read_text())
+    assert committed == extract_native_schema(
+        cc, "native/coordinator/coordinator.cc"
+    )
+    assert len(committed["ops"]) >= 18
+    assert committed["epoch_stamped"] is True
+
+
+# -- parallel engine -----------------------------------------------------------
+
+
+def test_parallel_jobs_produce_identical_findings(tmp_path):
+    for i in range(3):
+        (tmp_path / f"mod{i}.py").write_text(textwrap.dedent(_BAD_EDL005))
+    serial = analyze([str(tmp_path)], root=str(tmp_path), jobs=1)
+    forked = analyze([str(tmp_path)], root=str(tmp_path), jobs=2)
+    as_tuples = lambda r: [  # noqa: E731
+        (f.path, f.line, f.col, f.rule, f.message) for f in r.findings
+    ]
+    assert as_tuples(serial) == as_tuples(forked)
+    assert serial.jobs == 1 and forked.jobs == 2
+
+
+def test_report_carries_per_rule_timings(tmp_path):
+    (tmp_path / "m.py").write_text("def f():\n    return 1\n")
+    report = analyze([str(tmp_path)], root=str(tmp_path), rules=["EDL005"])
+    assert "EDL005" in report.timings
+    assert report.timings["EDL005"] >= 0.0

@@ -34,6 +34,7 @@ needs_native = pytest.mark.skipif(
 
 
 @needs_native
+@pytest.mark.sanitizer
 def test_batch_roundtrip_and_subop_dedup_inside_frame():
     """Two acquire sub-ops with the SAME req_id in ONE frame: the dedup
     cache resolves the second to the first's lease — the lost-reply retry
@@ -55,6 +56,7 @@ def test_batch_roundtrip_and_subop_dedup_inside_frame():
 
 
 @needs_native
+@pytest.mark.sanitizer
 def test_batch_subops_inherit_frame_worker_and_reject_unbatchable():
     with CoordinatorServer() as server:
         c = server.client("w0")
@@ -70,6 +72,7 @@ def test_batch_subops_inherit_frame_worker_and_reject_unbatchable():
 
 
 @needs_native
+@pytest.mark.sanitizer
 def test_batch_replies_carry_epoch_and_update_observed():
     with CoordinatorServer() as server:
         c = server.client("w0")
@@ -103,6 +106,7 @@ def test_inprocess_call_batch_parity():
 
 @pytest.mark.chaos
 @needs_native
+@pytest.mark.sanitizer
 def test_batched_outbox_replay_across_kill_and_restart(tmp_path):
     """Mutations buffered through a partition + coordinator SIGKILL replay
     as batch frames after restart and land exactly once."""
@@ -157,6 +161,7 @@ def test_batched_outbox_replay_across_kill_and_restart(tmp_path):
 
 @pytest.mark.chaos
 @needs_native
+@pytest.mark.sanitizer
 def test_snapshot_compaction_under_batched_load_survives_kill(tmp_path):
     """Enough batched mutations to cross the compaction threshold, then
     SIGKILL: the compacted snapshot + tail journal restore full state."""
@@ -194,6 +199,7 @@ def test_snapshot_compaction_under_batched_load_survives_kill(tmp_path):
 
 
 @needs_native
+@pytest.mark.sanitizer
 def test_piggyback_heartbeat_wraps_calls_into_batches():
     with CoordinatorServer(heartbeat_ttl_sec=60.0) as server:
         c = CoordinatorClient(port=server.port, worker="w0",

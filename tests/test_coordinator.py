@@ -132,6 +132,7 @@ def test_departed_worker_leases_requeue_immediately(coord):
     b.leave()
 
 
+@pytest.mark.sanitizer
 def test_barrier_releases_all(coord):
     n = 3
     clients = [coord.client(f"bar-{i}") for i in range(n)]
@@ -207,6 +208,7 @@ def test_kv_non_ascii_and_control_chars_roundtrip(coord):
     assert c.kv_get("ctl") == "a\x01b\x0bc"
 
 
+@pytest.mark.sanitizer
 def test_sync_rendezvous_all_members(coord):
     """Epoch sync: released only when every member arrives; a joiner mid-wait
     forces resync with the new epoch."""
@@ -318,6 +320,7 @@ def test_native_default_bind_is_loopback_only():
         server.stop()
 
 
+@pytest.mark.sanitizer
 def test_native_state_survives_kill_and_restart(tmp_path):
     """SIGKILL the coordinator mid-job and restart it on the same state file:
     the done-set survives (no full dataset replay), live leases are restored

@@ -36,7 +36,9 @@ needs_native = pytest.mark.skipif(
     not has_toolchain(), reason="native toolchain unavailable"
 )
 
-pytestmark = pytest.mark.chaos
+# Every outage test also rides the sanitizer lane (`make tsan-smoke`): the
+# kill/restart/partition interleavings here are exactly what TSan should see.
+pytestmark = [pytest.mark.chaos, pytest.mark.sanitizer]
 
 
 # -- retry policy --------------------------------------------------------------

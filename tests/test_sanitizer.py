@@ -1,0 +1,157 @@
+"""Sanitizer lane: drive the TSan/ASan-instrumented coordinator binary.
+
+These tests only run when ``EDL_COORD_SANITIZER`` is set (``make tsan-smoke``
+exports ``tsan``); in a plain tier-1 run they skip, so the lane costs nothing
+unless explicitly requested. With the env var set, every
+:class:`CoordinatorServer` in the process — including the chaos/outage/batch
+tests that share the ``sanitizer`` mark — builds and spawns the instrumented
+variant, the child exits 66 on a sanitizer report (TSAN_OPTIONS/ASAN_OPTIONS
+set by ``server.start()``), and :meth:`CoordinatorServer.sanitizer_report`
+surfaces the stderr so the assertion failure carries the actual report.
+
+The hammer here is deliberately contention-heavy: concurrent registration,
+KV increments on a shared key, task queue churn, and barriers — the code
+paths where the dispatch thread, TTL sweeper, and deferred-release logic
+interleave.
+"""
+
+import os
+import threading
+
+import pytest
+
+from edl_tpu.coordinator import CoordinatorServer
+from edl_tpu.coordinator.client import CoordinatorError
+from edl_tpu.coordinator.server import (
+    SANITIZER_VARIANTS,
+    ensure_built,
+    sanitizer_variant,
+)
+
+pytestmark = pytest.mark.sanitizer
+
+_ACTIVE = os.environ.get("EDL_COORD_SANITIZER", "").strip().lower()
+
+needs_sanitizer = pytest.mark.skipif(
+    not _ACTIVE,
+    reason="EDL_COORD_SANITIZER not set (run via `make tsan-smoke`)",
+)
+
+
+def _server(**kw) -> CoordinatorServer:
+    try:
+        ensure_built()
+    except CoordinatorError as e:
+        pytest.skip(f"sanitizer toolchain unavailable: {str(e)[:200]}")
+    return CoordinatorServer(**kw)
+
+
+def _assert_clean(server: CoordinatorServer) -> None:
+    report = server.sanitizer_report()
+    assert "ThreadSanitizer" not in report, report[-4000:]
+    assert "AddressSanitizer" not in report, report[-4000:]
+    assert "runtime error:" not in report, report[-4000:]  # UBSan
+
+
+@needs_sanitizer
+def test_variant_selects_instrumented_binary():
+    variant = sanitizer_variant()
+    assert variant in SANITIZER_VARIANTS and variant != ""
+    binary = ensure_built()
+    assert binary.endswith(SANITIZER_VARIANTS[variant])
+
+
+@needs_sanitizer
+def test_concurrent_clients_hammer_is_race_free():
+    """N threads × (register, heartbeat, shared kv_incr, queue churn) — the
+    hottest mutex neighborhoods in the dispatcher, under the sanitizer."""
+    n_workers, iters = 4, 12  # TSan is ~10x slower; keep the soak bounded
+    with _server(task_lease_sec=2.0, heartbeat_ttl_sec=5.0) as server:
+        with server.client("seed") as seeder:
+            seeder.register()
+            seeder.add_tasks([f"t{i}" for i in range(n_workers * iters)])
+        errors = []
+
+        def churn(i: int) -> None:
+            try:
+                with server.client(f"ham-{i}") as c:
+                    c.register()
+                    for _ in range(iters):
+                        c.heartbeat()
+                        c.kv_incr("shared-counter")
+                        task = c.acquire_task()
+                        if task is not None:
+                            c.complete_task(task)
+                    c.leave()
+            except Exception as e:  # surface, don't deadlock the join below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=churn, args=(i,)) for i in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        with server.client("check") as c:
+            c.register()
+            assert int(c.kv_get("shared-counter")) == n_workers * iters
+        assert server.poll() is None, (
+            f"coordinator died under load (rc={server.poll()}): "
+            + server.sanitizer_report()[-4000:]
+        )
+    _assert_clean(server)
+
+
+@needs_sanitizer
+def test_barrier_rendezvous_under_sanitizer():
+    """Barriers park fds for deferred release — the cross-thread handoff the
+    epoch-stamp conformance pass (EDL007) models; prove it data-race-free."""
+    n = 3
+    with _server() as server:
+        clients = [server.client(f"bar-{i}") for i in range(n)]
+        for c in clients:
+            c.register()
+        results = [None] * n
+
+        def arrive(i: int) -> None:
+            results[i] = clients[i].barrier("san-step", n, timeout=30.0)
+
+        threads = [threading.Thread(target=arrive, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(r is not None and r["ok"] for r in results), results
+        for c in clients:
+            c.leave()
+            c.close()
+    _assert_clean(server)
+
+
+@needs_sanitizer
+def test_kill_restart_cycle_reports_accumulate(tmp_path):
+    """SIGKILL mid-flight then restart on the same state file: the report
+    harvest must survive the respawn (a TSan hit in incarnation 1 may only
+    print at exit) and the resumed process must stay clean."""
+    state = str(tmp_path / "san-state.jsonl")
+    server = _server(state_file=state, run_id="san-run")
+    server.start()
+    try:
+        with server.client("w0") as c:
+            c.register()
+            c.add_tasks(["a", "b", "c"])
+            c.kv_put("k", "v1")
+        server.kill()
+        server.restart()
+        with server.client("w0") as c:
+            c.register(takeover=True)
+            assert c.kv_get("k") == "v1"
+            assert c.status()["queued"] >= 1
+    finally:
+        server.stop()
+    assert server.poll() != 66, (
+        "sanitizer exit code: " + server.sanitizer_report()[-4000:]
+    )
+    _assert_clean(server)
