@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py
 
-.PHONY: analyze analyze-json baseline test chaos lint obs-smoke tsan-smoke verify bench-pipeline bench-coord bench-collective
+.PHONY: analyze analyze-json baseline test chaos lint obs-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -33,6 +33,15 @@ chaos:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.obs
 
+## Protocol behavior gate: bounded explicit-state exploration of every
+## interleaving of the default faulty 2-worker schedule (crash+restart,
+## duplicate delivery, batch frame), each trace replayed against
+## InProcessCoordinator as the executable oracle. Exit 1 on any invariant
+## violation (epoch monotonicity, exactly-once, lease exclusivity,
+## progress) or model/oracle divergence. See doc/analysis.md (EDL009).
+modelcheck:
+	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.analysis.modelcheck
+
 ## Native race gate: rebuild the coordinator under ThreadSanitizer and rerun
 ## the sanitizer-marked lane (chaos/outage/batch/hammer tests) against it.
 ## EDL_COORD_SANITIZER=tsan makes every CoordinatorServer in the run spawn
@@ -47,9 +56,10 @@ tsan-smoke:
 			$(PYTHON) -m pytest tests/ -q -m 'sanitizer and not slow'; \
 	fi
 
-## Everything a PR must pass: static analysis (EDL001-EDL007 vs baseline +
-## protocol_schema.json ratchet), tier-1 tests, TSan lane.
-verify: analyze test tsan-smoke
+## Everything a PR must pass: static analysis (EDL001-EDL009 vs baseline +
+## protocol_schema.json ratchet), tier-1 tests, protocol model check,
+## TSan lane.
+verify: analyze test modelcheck tsan-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -5,10 +5,15 @@ by: shared controller/coordinator state mutated during a rescale must be
 lock-guarded (EDL001), the jitted hot path must not retrace or call back
 into the host (EDL002), PartitionSpec axis names must exist on the meshes
 we actually build (EDL003), coordinator handler paths must never block
-while holding the service lock (EDL004), and failures must not vanish into
-bare ``except`` handlers (EDL005). This package is an AST-based engine with
-one checker per invariant, a baseline file to ratchet existing debt down,
-and per-line suppression via ``# edl: noqa[RULE]``.
+while holding the service lock (EDL004), failures must not vanish into
+bare ``except`` handlers (EDL005), attributes reached from multiple thread
+roots must share a lock (EDL006), the wire protocol's three
+implementations must agree (EDL007), training state must not depend on
+host identity or unordered iteration (EDL008), and the protocol's declared
+state effects must survive bounded model checking against the in-process
+coordinator (EDL009). This package is an AST-based engine with one checker
+per invariant, a baseline file to ratchet existing debt down, and per-line
+suppression via ``# edl: noqa[RULE]``.
 
 Run it as ``python -m edl_tpu.analysis edl_tpu/`` or through
 ``tests/test_analysis.py`` (tier-1: the committed tree must be clean

@@ -9,6 +9,10 @@ from edl_tpu.analysis.checkers.blocking import BlockingInLockChecker
 from edl_tpu.analysis.checkers.exception_hygiene import ExceptionHygieneChecker
 from edl_tpu.analysis.checkers.thread_races import ThreadRaceChecker
 from edl_tpu.analysis.checkers.wire_protocol import WireProtocolChecker
+from edl_tpu.analysis.checkers.elastic_determinism import (
+    ElasticDeterminismChecker,
+)
+from edl_tpu.analysis.checkers.protocol_model import ProtocolModelChecker
 
 ALL_CHECKERS = (
     LockDisciplineChecker,
@@ -18,6 +22,8 @@ ALL_CHECKERS = (
     ExceptionHygieneChecker,
     ThreadRaceChecker,
     WireProtocolChecker,
+    ElasticDeterminismChecker,
+    ProtocolModelChecker,
 )
 
 RULES = {c.rule: c for c in ALL_CHECKERS}

@@ -60,8 +60,9 @@ class ShardingConsistencyChecker:
         rule="EDL003",
         name="sharding-consistency",
         description=(
-            "PartitionSpec / collective axis names used in parallel/ and "
-            "models/ must be declared by AXIS_ORDER in parallel/mesh.py"
+            "PartitionSpec / collective axis names used in parallel/, "
+            "models/, and runtime/ must be declared by AXIS_ORDER in "
+            "parallel/mesh.py"
         ),
     )
 
@@ -95,7 +96,9 @@ class ShardingConsistencyChecker:
         rel = sf.relpath
         if rel.endswith("parallel/mesh.py"):
             return False  # the declaration site itself
-        return "parallel/" in rel or "models/" in rel
+        # runtime/ joined the scope when PR 6's ZeRO specs put P(...)
+        # literals there (_zero_specs / zero_shard_spec).
+        return "parallel/" in rel or "models/" in rel or "runtime/" in rel
 
     def _declared_axes(self, ctx) -> Optional[Set[str]]:
         override = ctx.config.get("sharding_axes")

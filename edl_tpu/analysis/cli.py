@@ -28,7 +28,9 @@ def _build_parser() -> argparse.ArgumentParser:
         description=(
             "Domain-specific static analysis for the elastic-training "
             "codebase (lock-discipline, trace-hygiene, sharding-"
-            "consistency, blocking-in-lock, exception-hygiene)."
+            "consistency, blocking-in-lock, exception-hygiene, "
+            "thread-races, wire-protocol, elastic-determinism, "
+            "protocol-model)."
         ),
     )
     parser.add_argument(
@@ -39,9 +41,12 @@ def _build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument(
         "--format",
-        choices=("text", "json"),
+        choices=("text", "json", "sarif"),
         default="text",
-        help="output format (default: text)",
+        help=(
+            "output format (default: text; sarif emits a SARIF 2.1.0 "
+            "document for CI code annotations)"
+        ),
     )
     parser.add_argument(
         "--rules",
@@ -93,7 +98,8 @@ def _build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help=(
             "re-extract the native wire schema into protocol_schema.json "
-            "(the EDL007 ratchet artifact) and exit 0"
+            "(the EDL007 ratchet artifact; the hand-authored state_effects "
+            "block is preserved) and exit 0"
         ),
     )
     return parser
@@ -110,6 +116,17 @@ def _write_protocol(root: str) -> int:
         print(f"error: {native_rel} not found under {root}", file=sys.stderr)
         return 2
     target = os.path.join(root, DEFAULT_SCHEMA_NAME)
+    # state_effects is hand-authored behavioral annotation (the EDL009
+    # model-check spec), not extractable from the .cc — carry it through
+    # regeneration so --write-protocol never silently drops it.
+    if os.path.isfile(target):
+        try:
+            with open(target, "r", encoding="utf-8") as f:
+                previous = json.load(f)
+        except (json.JSONDecodeError, OSError):
+            previous = {}
+        if isinstance(previous, dict) and "state_effects" in previous:
+            schema["state_effects"] = previous["state_effects"]
     with open(target, "w", encoding="utf-8") as f:
         json.dump(schema, f, indent=2, sort_keys=True)
         f.write("\n")
@@ -171,7 +188,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         new, accepted, stale = report.findings, [], []
 
-    if args.format == "json":
+    if args.format == "sarif":
+        from edl_tpu.analysis.sarif import to_sarif
+
+        print(json.dumps(to_sarif(new, accepted), indent=2))
+    elif args.format == "json":
         payload = {
             "version": 1,
             "findings": [
@@ -186,6 +207,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "jobs": report.jobs,
                 "timings": {
                     r: round(s, 4) for r, s in sorted(report.timings.items())
+                },
+                "reduce_timings": {
+                    r: round(s, 4)
+                    for r, s in sorted(report.reduce_timings.items())
                 },
                 "parse_errors": [
                     {"path": p, "error": e} for p, e in report.parse_errors
@@ -214,7 +239,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         if args.timings:
             for rule, sec in sorted(report.timings.items()):
-                print(f"  {rule}: {sec:.3f}s")
+                print(f"  {rule}: {sec:.3f}s (map)")
+            for rule, sec in sorted(report.reduce_timings.items()):
+                print(f"  {rule}: {sec:.3f}s (reduce)")
             print(f"  jobs: {report.jobs}")
 
     if report.parse_errors:

@@ -64,9 +64,15 @@ class Report:
     suppressed: List[Finding]
     files_checked: int
     parse_errors: List[Tuple[str, str]] = field(default_factory=list)
-    #: rule id -> cumulative seconds spent in that checker (file checkers sum
-    #: across files; program checkers sum summarize + reduce).
+    #: rule id -> cumulative seconds in the per-file (map) phase: file
+    #: checkers sum ``check`` across files; program checkers sum
+    #: ``summarize``. With ``--jobs`` this is CPU time across the pool,
+    #: not wall clock.
     timings: Dict[str, float] = field(default_factory=dict)
+    #: rule id -> seconds in the in-parent reduce phase (program checkers
+    #: only). Kept separate from ``timings`` because reduce is serial wall
+    #: clock — a slow reduce can't be bought back with more jobs.
+    reduce_timings: Dict[str, float] = field(default_factory=dict)
     #: worker processes used for the per-file phase (1 = in-process serial).
     jobs: int = 1
 
@@ -320,6 +326,7 @@ def analyze(
             timings[rule] = timings.get(rule, 0.0) + sec
 
     ctx = AnalysisContext(root=root, config=config)
+    reduce_timings: Dict[str, float] = {}
     for checker in _checkers_by_rule(program_rules):
         t0 = time.perf_counter()
         for finding in checker.reduce(summaries[checker.rule], ctx):
@@ -337,8 +344,8 @@ def analyze(
                 suppressed.append(finding)
             else:
                 findings.append(finding)
-        timings[checker.rule] = (
-            timings.get(checker.rule, 0.0) + time.perf_counter() - t0
+        reduce_timings[checker.rule] = (
+            reduce_timings.get(checker.rule, 0.0) + time.perf_counter() - t0
         )
 
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
@@ -349,5 +356,6 @@ def analyze(
         files_checked=n_files,
         parse_errors=errors,
         timings=timings,
+        reduce_timings=reduce_timings,
         jobs=n_jobs,
     )

@@ -1,0 +1,945 @@
+"""Protocol state-machine model checker (the engine behind EDL009).
+
+EDL007 proved the coordinator protocol's *shape* agrees across the C++
+server, the wire client, and the in-process twin. This module checks its
+*behavior*: the per-op ``state_effects`` block of ``protocol_schema.json``
+declares how each op touches coordinator state (epoch bumps, lease
+acquire/release, dedup keys, fd-parking), a small abstract interpreter
+(`ProtocolModel`) executes those declarations, and a bounded explicit-state
+explorer enumerates every interleaving of N scripted workers — including
+crash/restart and duplicate-delivery faults — checking four invariants on
+every trace:
+
+- **epoch monotonicity**: the epoch observed on any worker's reply stream
+  never decreases;
+- **exactly-once**: a replayed ``req_id``/``op_id`` must return the original
+  effect (same task, same counter value), never apply a second one;
+- **lease exclusivity**: at most one live lease per task, transfers only
+  through an explicit requeue event (complete/fail/takeover/drop);
+- **progress**: every parked op (barrier/sync) is eventually released and
+  every script drains — a schedule where all runnable workers are parked is
+  a deadlock, reported without replay.
+
+Every completed trace is then replayed op-for-op against a fresh
+``InProcessCoordinator`` (the executable oracle): each model-predicted reply
+must be a subset of the oracle's reply, with the epoch matching exactly. A
+model/oracle divergence means either the schema's behavioral annotations or
+the twin drifted — both are findings.
+
+Exploration is exhaustive by default (DFS over all interleavings) and can
+run as a seeded random walk (``fuzz_samples``/``fuzz_seed``), whose explored
+trace set — and therefore violation set — is provably a subset of the
+exhaustive run at equal depth: both draw schedules from the same runnable
+sets, the walk just samples one branch per node.
+
+``python -m edl_tpu.analysis.modelcheck`` runs the default bounded
+configuration (2 workers, 13 ops including ``batch``, one crash+restart,
+two duplicate deliveries) and exits 1 on any violation — the ``make
+modelcheck`` gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+#: ops a ``call_batch`` frame refuses (they park or nest framing);
+#: mirrored from the wire protocol, used by the composite handler.
+_NON_BATCHABLE = ("batch", "barrier", "sync")
+
+#: sentinel request-field value: resolved at issue time to the task named in
+#: the issuing worker's most recent acquire reply (each side — model and
+#: oracle — resolves from its OWN reply stream, so a grant divergence is
+#: reported once at the acquire, not echoed by every downstream op).
+LAST_TASK = "__edl_modelcheck_last_task__"
+
+
+class ModelCheckError(Exception):
+    """The schema's state_effects block cannot drive the model (missing op,
+    unknown effect tag): a behavioral-spec error, not a trace violation."""
+
+
+@dataclass(frozen=True)
+class ScriptOp:
+    """One scripted client op. ``note`` tags fault injections ("dup",
+    "restart") for trace rendering; semantics live entirely in op+fields."""
+
+    op: str
+    fields: Tuple[Tuple[str, Any], ...] = ()
+    note: str = ""
+
+    @staticmethod
+    def make(op: str, note: str = "", **fields: Any) -> "ScriptOp":
+        frozen = []
+        for k in sorted(fields):
+            v = fields[k]
+            if isinstance(v, list):
+                v = tuple(
+                    tuple(sorted(d.items())) if isinstance(d, dict) else d
+                    for v_ in [v] for d in v_
+                )
+            frozen.append((k, v))
+        return ScriptOp(op=op, fields=tuple(frozen), note=note)
+
+    def field_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in self.fields:
+            if isinstance(v, tuple) and v and isinstance(v[0], tuple):
+                # list-of-dicts (batch sub-ops) round-trips through tuples
+                out[k] = [dict(item) for item in v]
+            elif isinstance(v, tuple):
+                out[k] = list(v)
+            else:
+                out[k] = v
+        return out
+
+    def render(self) -> str:
+        parts = ", ".join(
+            f"{k}={v!r}" for k, v in self.fields if k != "ops"
+        )
+        tag = f" [{self.note}]" if self.note else ""
+        return f"{self.op}({parts}){tag}"
+
+
+@dataclass
+class Violation:
+    kind: str  # epoch-monotonicity | exactly-once | lease-exclusivity |
+    #            progress | oracle-divergence | conservation
+    message: str
+    trace: str  # stable rendering of the schedule that produced it
+
+    def key(self) -> Tuple[str, str]:
+        return (self.kind, self.trace)
+
+
+@dataclass
+class ModelCheckResult:
+    traces: int = 0
+    replays: int = 0
+    violations: List[Violation] = field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not self.violations
+
+    def violation_keys(self) -> set:
+        return {v.key() for v in self.violations}
+
+
+# -- the abstract model --------------------------------------------------------
+
+
+class ProtocolModel:
+    """Explicit-state interpreter for the coordinator protocol, driven by the
+    ``state_effects`` declarations. Predicts, for every (worker, op, fields)
+    event, the reply the real coordinator must produce; the oracle replay
+    checks the prediction. Time never passes: leases and heartbeats cannot
+    expire, which matches the replay coordinator's near-infinite TTLs."""
+
+    _KNOWN_TAGS = {
+        "epoch", "lease", "dedup", "kv", "queue", "membership", "parks",
+        "composite",
+    }
+
+    def __init__(self, effects: Dict[str, Dict[str, Any]]):
+        for op, tags in effects.items():
+            unknown = set(tags) - self._KNOWN_TAGS
+            if unknown:
+                raise ModelCheckError(
+                    f"state_effects[{op!r}] has unknown tag(s): "
+                    f"{sorted(unknown)}"
+                )
+        self.effects = effects
+        self.epoch = 0
+        self.members: Dict[str, int] = {}  # name -> rank
+        self.next_rank = 0
+        self.todo: List[str] = []
+        self.leased: Dict[str, str] = {}  # task -> worker (insertion-ordered)
+        self.done: set = set()
+        self.acquire_cache: Dict[str, Tuple[str, str]] = {}
+        self.kv: Dict[str, str] = {}
+        self.barriers: Dict[str, Dict[str, Any]] = {}
+        self.sync_arrived: set = set()
+        self.sync_generation = 0
+
+    def copy(self) -> "ProtocolModel":
+        m = ProtocolModel.__new__(ProtocolModel)
+        m.effects = self.effects
+        m.epoch = self.epoch
+        m.members = dict(self.members)
+        m.next_rank = self.next_rank
+        m.todo = list(self.todo)
+        m.leased = dict(self.leased)
+        m.done = set(self.done)
+        m.acquire_cache = dict(self.acquire_cache)
+        m.kv = dict(self.kv)
+        m.barriers = {
+            k: {"arrived": set(v["arrived"]), "generation": v["generation"],
+                "want": v["want"]}
+            for k, v in self.barriers.items()
+        }
+        m.sync_arrived = set(self.sync_arrived)
+        m.sync_generation = self.sync_generation
+        return m
+
+    # Every handler returns (reply_prediction | None-if-parked, released)
+    # where released is [(worker, reply_prediction), ...] for parked ops
+    # this event unblocked.
+
+    def apply(self, worker: str, op: str, fields: Dict[str, Any]):
+        if op not in self.effects:
+            raise ModelCheckError(
+                f"op {op!r} has no state_effects entry in the schema"
+            )
+        handler = getattr(self, f"_op_{op}", None)
+        if handler is None:
+            raise ModelCheckError(f"model has no handler for op {op!r}")
+        return handler(worker, fields)
+
+    def _membership_reply(self, worker: str) -> Dict[str, Any]:
+        rank = self.members.get(worker, -1)
+        return {"ok": True, "rank": rank, "epoch": self.epoch,
+                "world": len(self.members)}
+
+    def _requeue_worker_leases(self, worker: str) -> None:
+        stale = [t for t, w in self.leased.items() if w == worker]
+        for t in stale:
+            del self.leased[t]
+            self.todo.append(t)
+
+    def _release_sync_on_epoch_change(self) -> List[Tuple[str, Dict]]:
+        """Membership moved (epoch already bumped): every parked sync wakes
+        and observes the epoch mismatch — resync replies."""
+        released = [
+            (w, {"ok": False, "resync": True, "epoch": self.epoch,
+                 "world": len(self.members)})
+            for w in sorted(self.sync_arrived)
+        ]
+        self.sync_arrived = set()
+        return released
+
+    def _op_register(self, worker: str, fields: Dict[str, Any]):
+        released: List[Tuple[str, Dict]] = []
+        tags = self.effects["register"]
+        if fields.get("takeover") and tags.get("lease") == "requeue_on_takeover":
+            self._requeue_worker_leases(worker)
+        if worker not in self.members:
+            self.members[worker] = self.next_rank
+            self.next_rank += 1
+            if tags.get("epoch") == "bump_on_join":
+                self.epoch += 1
+                released = self._release_sync_on_epoch_change()
+        return self._membership_reply(worker), released
+
+    def _op_heartbeat(self, worker: str, fields: Dict[str, Any]):
+        if worker not in self.members:
+            return ({"ok": False, "error": "unknown worker",
+                     "epoch": self.epoch}, [])
+        return self._membership_reply(worker), []
+
+    def _op_leave(self, worker: str, fields: Dict[str, Any]):
+        # The shim binds leave to the calling client's own worker name; the
+        # "worker" request field is envelope, not a target selector.
+        target = worker
+        released: List[Tuple[str, Dict]] = []
+        if target in self.members:
+            del self.members[target]
+            ranked = sorted(self.members.items(), key=lambda kv: kv[1])
+            for r, (name, _) in enumerate(ranked):
+                self.members[name] = r
+            self.next_rank = len(self.members)
+            if self.effects["leave"].get("epoch") == "bump_on_drop":
+                self.epoch += 1
+            self._requeue_worker_leases(target)
+            self.acquire_cache.pop(target, None)
+            released = self._release_sync_on_epoch_change()
+        return {"ok": True, "epoch": self.epoch}, released
+
+    def _op_members(self, worker: str, fields: Dict[str, Any]):
+        names = [n for n, _ in sorted(self.members.items(),
+                                      key=lambda kv: kv[1])]
+        return {"ok": True, "members": names, "epoch": self.epoch}, []
+
+    def _op_ping(self, worker: str, fields: Dict[str, Any]):
+        return {"ok": True, "pong": True, "epoch": self.epoch}, []
+
+    def _op_add_tasks(self, worker: str, fields: Dict[str, Any]):
+        added = 0
+        for t in fields.get("tasks", []):
+            if t in self.done or t in self.leased or t in self.todo:
+                continue
+            self.todo.append(t)
+            added += 1
+        return ({"ok": True, "added": added, "queued": len(self.todo),
+                 "epoch": self.epoch}, [])
+
+    def _op_acquire_task(self, worker: str, fields: Dict[str, Any]):
+        req_id = fields.get("req_id")
+        if req_id and self.effects["acquire_task"].get("dedup") == "req_id":
+            cached = self.acquire_cache.get(worker)
+            if cached and cached[0] == req_id:
+                task = cached[1]
+                if self.leased.get(task) == worker:
+                    return ({"ok": True, "task": task, "duplicate": True,
+                             "epoch": self.epoch}, [])
+        if not self.todo:
+            return ({"ok": True, "task": None,
+                     "exhausted": not self.leased, "epoch": self.epoch}, [])
+        task = self.todo.pop(0)
+        self.leased[task] = worker
+        if req_id:
+            self.acquire_cache[worker] = (req_id, task)
+        return {"ok": True, "task": task, "epoch": self.epoch}, []
+
+    def _op_complete_task(self, worker: str, fields: Dict[str, Any]):
+        task = fields.get("task")
+        if task in self.done:
+            return ({"ok": True, "duplicate": True, "done": len(self.done),
+                     "queued": len(self.todo), "epoch": self.epoch}, [])
+        if task not in self.leased:
+            if task in self.todo:
+                self.todo.remove(task)
+                self.done.add(task)
+                return ({"ok": True, "requeued": True,
+                         "done": len(self.done), "queued": len(self.todo),
+                         "epoch": self.epoch}, [])
+            return ({"ok": False, "error": "not leased",
+                     "epoch": self.epoch}, [])
+        if self.leased[task] != worker:
+            return ({"ok": False, "error": "lease not owned",
+                     "epoch": self.epoch}, [])
+        del self.leased[task]
+        self.done.add(task)
+        return ({"ok": True, "done": len(self.done),
+                 "queued": len(self.todo), "epoch": self.epoch}, [])
+
+    def _op_fail_task(self, worker: str, fields: Dict[str, Any]):
+        task = fields.get("task")
+        if task not in self.leased:
+            return ({"ok": False, "error": "not leased",
+                     "epoch": self.epoch}, [])
+        if self.leased[task] != worker:
+            return ({"ok": False, "error": "lease not owned",
+                     "epoch": self.epoch}, [])
+        del self.leased[task]
+        self.todo.append(task)
+        return {"ok": True, "epoch": self.epoch}, []
+
+    def _op_kv_put(self, worker: str, fields: Dict[str, Any]):
+        key = fields.get("key")
+        if not key:
+            return ({"ok": False, "error": "key required",
+                     "epoch": self.epoch}, [])
+        self.kv[key] = fields.get("value")
+        return {"ok": True, "epoch": self.epoch}, []
+
+    def _op_kv_get(self, worker: str, fields: Dict[str, Any]):
+        return ({"ok": True, "value": self.kv.get(fields.get("key")),
+                 "epoch": self.epoch}, [])
+
+    def _op_kv_del(self, worker: str, fields: Dict[str, Any]):
+        self.kv.pop(fields.get("key"), None)
+        return {"ok": True, "epoch": self.epoch}, []
+
+    def _op_kv_incr(self, worker: str, fields: Dict[str, Any]):
+        key = fields.get("key", "")
+        if not key:
+            return ({"ok": False, "error": "key required",
+                     "epoch": self.epoch}, [])
+        op_id = fields.get("op_id")
+        marker = f"__edl_op/{op_id}" if op_id else None
+        if (marker and marker in self.kv
+                and self.effects["kv_incr"].get("dedup") == "op_id"):
+            return ({"ok": True, "value": int(self.kv[marker]),
+                     "duplicate": True, "epoch": self.epoch}, [])
+        cur = int(self.kv.get(key, "0") or "0") + int(fields.get("delta", 1))
+        self.kv[key] = str(cur)
+        if marker:
+            self.kv[marker] = str(cur)
+        return {"ok": True, "value": cur, "epoch": self.epoch}, []
+
+    def _op_bump_epoch(self, worker: str, fields: Dict[str, Any]):
+        self.epoch += 1
+        released = self._release_sync_on_epoch_change()
+        return {"ok": True, "epoch": self.epoch}, released
+
+    def _op_status(self, worker: str, fields: Dict[str, Any]):
+        return ({"ok": True, "epoch": self.epoch,
+                 "world": len(self.members), "queued": len(self.todo),
+                 "leased": len(self.leased), "done": len(self.done)}, [])
+
+    def _op_batch(self, worker: str, fields: Dict[str, Any]):
+        if not self.effects["batch"].get("composite"):
+            raise ModelCheckError(
+                "state_effects['batch'] lost its composite tag"
+            )
+        replies = []
+        released: List[Tuple[str, Dict]] = []
+        for sub in fields.get("ops", []):
+            sub = dict(sub)
+            sub_op = sub.pop("op", "")
+            if sub_op in _NON_BATCHABLE:
+                replies.append(
+                    {"ok": False, "error": f"op not batchable: {sub_op}"})
+                continue
+            reply, rel = self.apply(worker, sub_op, sub)
+            replies.append(reply)
+            released.extend(rel)
+        return ({"ok": True, "replies": replies, "epoch": self.epoch},
+                released)
+
+    # Parked ops return (None, released): the caller must park the worker.
+
+    def _op_barrier(self, worker: str, fields: Dict[str, Any]):
+        name = fields["name"]
+        count = int(fields["count"])
+        b = self.barriers.setdefault(
+            name, {"arrived": set(), "generation": 0, "want": 0})
+        if not b["arrived"]:
+            b["want"] = count
+        elif count != b["want"]:
+            return ({"ok": False, "error": "barrier count mismatch",
+                     "want": b["want"], "epoch": self.epoch}, [])
+        gen = b["generation"]
+        b["arrived"].add(worker)
+        if len(b["arrived"]) >= b["want"]:
+            b["generation"] += 1
+            parked = sorted(b["arrived"] - {worker})
+            b["arrived"] = set()
+            released = [
+                (w, {"ok": True, "barrier": name, "generation": gen,
+                     "epoch": self.epoch})
+                for w in parked
+            ]
+            return ({"ok": True, "barrier": name, "generation": gen,
+                     "epoch": self.epoch}, released)
+        return None, []  # parked
+
+    def _op_sync(self, worker: str, fields: Dict[str, Any]):
+        if worker not in self.members:
+            return ({"ok": False, "error": "unknown worker",
+                     "epoch": self.epoch, "world": len(self.members)}, [])
+        if int(fields["epoch"]) != self.epoch:
+            return ({"ok": False, "resync": True, "epoch": self.epoch,
+                     "world": len(self.members)}, [])
+        self.sync_arrived.add(worker)
+        if self.sync_arrived >= set(self.members):
+            parked = sorted(self.sync_arrived - {worker})
+            self.sync_arrived = set()
+            self.sync_generation += 1
+            reply = {"ok": True, "epoch": self.epoch,
+                     "world": len(self.members)}
+            return reply, [(w, dict(reply)) for w in parked]
+        return None, []  # parked
+
+
+# -- explorer ------------------------------------------------------------------
+
+
+@dataclass
+class _Event:
+    """One scheduled op in a concrete trace, with the model's prediction."""
+
+    worker: str
+    op: ScriptOp
+    fields: Dict[str, Any]  # LAST_TASK already resolved (model view)
+    predicted: Optional[Dict[str, Any]]  # None while parked
+    parked: bool = False
+    released_at: Optional[int] = None  # index of the releasing event
+
+
+def _resolve_last_task(fields: Dict[str, Any], last_task: Any):
+    out = {}
+    for k, v in fields.items():
+        if v == LAST_TASK:
+            out[k] = last_task
+        elif k == "ops" and isinstance(v, list):
+            out[k] = [_resolve_last_task(dict(sub), last_task) for sub in v]
+        else:
+            out[k] = v
+    return out
+
+
+def _grants_from_reply(op: str, fields: Dict[str, Any], reply: Any):
+    """(task, duplicate) grant observations in a reply (incl. batch subs)."""
+    if not isinstance(reply, dict):
+        return
+    if op == "acquire_task" and reply.get("ok") and reply.get("task"):
+        yield reply["task"], bool(reply.get("duplicate")), fields.get("req_id")
+    if op == "batch":
+        subs = fields.get("ops", [])
+        for sub, sub_reply in zip(subs, reply.get("replies", []) or []):
+            sub_op = sub.get("op", "")
+            yield from _grants_from_reply(sub_op, sub, sub_reply)
+
+
+class _TraceState:
+    """One DFS node: per-worker program counters + parked set + model."""
+
+    def __init__(self, scripts: Dict[str, Sequence[ScriptOp]],
+                 model: ProtocolModel):
+        self.scripts = scripts
+        self.pcs = {w: 0 for w in scripts}
+        self.parked: Dict[str, int] = {}  # worker -> event index in trace
+        self.last_task: Dict[str, Any] = {w: None for w in scripts}
+        self.model = model
+        self.trace: List[_Event] = []
+
+    def runnable(self) -> List[str]:
+        return sorted(
+            w for w, pc in self.pcs.items()
+            if pc < len(self.scripts[w]) and w not in self.parked
+        )
+
+    def done(self) -> bool:
+        return not self.parked and all(
+            pc >= len(self.scripts[w]) for w, pc in self.pcs.items()
+        )
+
+    def copy(self) -> "_TraceState":
+        st = _TraceState.__new__(_TraceState)
+        st.scripts = self.scripts
+        st.pcs = dict(self.pcs)
+        st.parked = dict(self.parked)
+        st.last_task = dict(self.last_task)
+        st.model = self.model.copy()
+        st.trace = [
+            _Event(e.worker, e.op, e.fields, e.predicted, e.parked,
+                   e.released_at)
+            for e in self.trace
+        ]
+        return st
+
+    def step(self, worker: str) -> None:
+        """Advance ``worker`` one op through the model."""
+        sop = self.scripts[worker][self.pcs[worker]]
+        self.pcs[worker] += 1
+        fields = _resolve_last_task(sop.field_dict(), self.last_task[worker])
+        predicted, released = self.model.apply(worker, sop.op, fields)
+        ev = _Event(worker=worker, op=sop, fields=fields,
+                    predicted=predicted, parked=predicted is None)
+        self.trace.append(ev)
+        idx = len(self.trace) - 1
+        if ev.parked:
+            self.parked[worker] = idx
+        else:
+            self._note_grants(worker, sop.op, fields, predicted)
+        for released_worker, reply in released:
+            parked_idx = self.parked.pop(released_worker, None)
+            if parked_idx is not None:
+                parked_ev = self.trace[parked_idx]
+                parked_ev.predicted = reply
+                parked_ev.parked = False
+                parked_ev.released_at = idx
+                self._note_grants(released_worker, parked_ev.op.op,
+                                  parked_ev.fields, reply)
+
+    def _note_grants(self, worker, op, fields, reply):
+        for task, _dup, _req in _grants_from_reply(op, fields, reply):
+            self.last_task[worker] = task
+
+    def render(self) -> str:
+        return " ; ".join(f"{e.worker}:{e.op.render()}" for e in self.trace)
+
+
+CoordinatorFactory = Callable[[], Any]
+
+
+def _default_coordinator_factory():
+    from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+    # Time must not pass for the model: near-infinite lease/TTL windows.
+    return InProcessCoordinator(task_lease_sec=1e9, heartbeat_ttl_sec=1e9)
+
+
+def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
+                  rendered: str, violations: List[Violation],
+                  join_timeout: float = 30.0) -> None:
+    """Execute the scheduled trace against a fresh coordinator and check
+    model predictions + runtime invariants on the oracle's replies."""
+    coord = factory()
+    clients = {}
+    last_task: Dict[str, Any] = {}
+    last_epoch: Dict[str, int] = {}
+    live_grants: Dict[str, str] = {}  # task -> worker (oracle view)
+    grants_by_req: Dict[Tuple[str, str], set] = {}
+    pending: Dict[int, Tuple[threading.Thread, List]] = {}
+    added_total = 0
+
+    def client(worker: str):
+        if worker not in clients:
+            clients[worker] = coord.client(worker)
+        return clients[worker]
+
+    def requeue_events(worker: str, op: str, fields: Dict[str, Any]):
+        """Lease-release points: a grant after one is a transfer, not a
+        violation. Mirrors the coordinator's requeue semantics."""
+        if op == "register" and fields.get("takeover"):
+            for t, w in list(live_grants.items()):
+                if w == worker:
+                    del live_grants[t]
+        if op == "leave":
+            for t, w in list(live_grants.items()):
+                if w == worker:
+                    del live_grants[t]
+        if op in ("fail_task", "complete_task"):
+            live_grants.pop(fields.get("task"), None)
+
+    def check_reply(idx: int, ev: _Event, fields: Dict[str, Any],
+                    reply: Any) -> None:
+        """``fields`` is the ORACLE-side resolution of the scripted op
+        (LAST_TASK bound from the oracle's own reply stream)."""
+        nonlocal added_total
+        where = f"step {idx} ({ev.worker}:{ev.op.render()})"
+        if not isinstance(reply, dict):
+            violations.append(Violation(
+                "oracle-divergence",
+                f"{where}: oracle returned non-dict reply {reply!r}",
+                rendered))
+            return
+        # model prediction must be a subset of the oracle reply, epoch exact
+        for key, want in (ev.predicted or {}).items():
+            have = reply.get(key, "<absent>")
+            if key == "replies":
+                continue  # batch sub-replies compared below
+            if have != want:
+                violations.append(Violation(
+                    "oracle-divergence",
+                    f"{where}: model predicts {key}={want!r}, oracle "
+                    f"replied {key}={have!r}",
+                    rendered))
+        if ev.op.op == "batch":
+            want_subs = (ev.predicted or {}).get("replies", [])
+            have_subs = reply.get("replies", [])
+            if len(want_subs) != len(have_subs):
+                violations.append(Violation(
+                    "oracle-divergence",
+                    f"{where}: batch sub-reply count mismatch "
+                    f"(model {len(want_subs)}, oracle {len(have_subs)})",
+                    rendered))
+            for j, (ws, hs) in enumerate(zip(want_subs, have_subs)):
+                for key, want in ws.items():
+                    if not isinstance(hs, dict) or hs.get(key, "<absent>") != want:
+                        violations.append(Violation(
+                            "oracle-divergence",
+                            f"{where} sub-op {j}: model predicts "
+                            f"{key}={want!r}, oracle replied "
+                            f"{(hs or {}).get(key, '<absent>')!r}",
+                            rendered))
+        # invariant: per-stream epoch monotonicity
+        if "epoch" in reply:
+            ep = int(reply["epoch"])
+            if ep < last_epoch.get(ev.worker, 0):
+                violations.append(Violation(
+                    "epoch-monotonicity",
+                    f"{where}: epoch went backwards "
+                    f"({last_epoch[ev.worker]} -> {ep}) on "
+                    f"{ev.worker}'s reply stream",
+                    rendered))
+            last_epoch[ev.worker] = max(last_epoch.get(ev.worker, 0), ep)
+        # invariants: exactly-once + lease exclusivity on oracle grants
+        requeue_events(ev.worker, ev.op.op, fields)
+        if ev.op.op == "batch":
+            for sub in fields.get("ops", []):
+                requeue_events(ev.worker, sub.get("op", ""), sub)
+        for task, dup, req_id in _grants_from_reply(
+                ev.op.op, fields, reply):
+            last_task[ev.worker] = task
+            if req_id:
+                seen = grants_by_req.setdefault((ev.worker, req_id), set())
+                seen.add(task)
+                if len(seen) > 1:
+                    violations.append(Violation(
+                        "exactly-once",
+                        f"{where}: req_id {req_id!r} was granted "
+                        f"{sorted(seen)} — a replayed acquire popped a "
+                        "second task instead of returning the original "
+                        "lease",
+                        rendered))
+            if not dup:
+                holder = live_grants.get(task)
+                if holder is not None and holder != ev.worker:
+                    violations.append(Violation(
+                        "lease-exclusivity",
+                        f"{where}: task {task!r} granted to {ev.worker} "
+                        f"while {holder} still holds the lease",
+                        rendered))
+                live_grants[task] = ev.worker
+        if ev.op.op == "add_tasks" and reply.get("ok"):
+            added_total += int(reply.get("added", 0))
+        if ev.op.op == "batch":
+            for sub, sub_reply in zip(fields.get("ops", []),
+                                      reply.get("replies", []) or []):
+                if (sub.get("op") == "add_tasks"
+                        and isinstance(sub_reply, dict)
+                        and sub_reply.get("ok")):
+                    added_total += int(sub_reply.get("added", 0))
+        if ev.op.op == "status" and reply.get("ok"):
+            # invariant: task conservation — at this point in the schedule
+            # every task added so far is queued, leased, or done.
+            total = (int(reply.get("queued", 0))
+                     + int(reply.get("leased", 0))
+                     + int(reply.get("done", 0)))
+            if total != added_total:
+                violations.append(Violation(
+                    "conservation",
+                    f"{where}: status queued+leased+done={total} != "
+                    f"tasks added so far={added_total}",
+                    rendered))
+
+    oracle_fields: Dict[int, Dict[str, Any]] = {}
+    for idx, ev in enumerate(trace):
+        # Resolve LAST_TASK from the ORACLE's own reply stream (ev.fields is
+        # the model-side resolution; the two views stay independent so a
+        # grant divergence is reported once, at the acquire).
+        fields = _resolve_last_task(ev.op.field_dict(),
+                                    last_task.get(ev.worker))
+        oracle_fields[idx] = fields
+        if ev.parked or ev.released_at is not None:
+            holder: List = []
+
+            def run(c=client(ev.worker), op=ev.op.op, f=fields, h=holder):
+                try:
+                    h.append(c.call(op, timeout=join_timeout, **f))
+                except Exception as e:  # edl: noqa[EDL005] stashed in holder; join() turns it into a violation
+                    h.append(e)
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            pending[idx] = (th, holder)
+        else:
+            reply = client(ev.worker).call(ev.op.op, **fields)
+            check_reply(idx, ev, fields, reply)
+        # join any parked ops this event released
+        for pidx in [p for p in list(pending)
+                     if trace[p].released_at == idx]:
+            th, holder = pending.pop(pidx)
+            th.join(join_timeout)
+            if th.is_alive() or not holder:
+                violations.append(Violation(
+                    "progress",
+                    f"step {pidx} ({trace[pidx].worker}:"
+                    f"{trace[pidx].op.render()}): oracle did not release "
+                    "the parked op the model says this event releases",
+                    rendered))
+                continue
+            reply = holder[0]
+            if isinstance(reply, Exception):
+                violations.append(Violation(
+                    "oracle-divergence",
+                    f"step {pidx}: parked op raised {reply!r}", rendered))
+                continue
+            check_reply(pidx, trace[pidx], oracle_fields[pidx], reply)
+
+    if pending:
+        violations.append(Violation(
+            "progress",
+            f"{len(pending)} parked op(s) never released by trace end",
+            rendered))
+
+
+def explore(
+    scripts: Dict[str, Sequence[ScriptOp]],
+    effects: Dict[str, Dict[str, Any]],
+    coordinator_factory: Optional[CoordinatorFactory] = None,
+    max_traces: int = 20000,
+    max_violations: int = 25,
+    fuzz_samples: int = 0,
+    fuzz_seed: int = 0,
+    replay: bool = True,
+) -> ModelCheckResult:
+    """Enumerate interleavings of ``scripts`` (exhaustive DFS, or a seeded
+    random walk when ``fuzz_samples > 0``), model-check each, and replay
+    completed traces against the oracle coordinator."""
+    factory = coordinator_factory or _default_coordinator_factory
+    result = ModelCheckResult()
+
+    def finish(state: _TraceState) -> None:
+        result.traces += 1
+        rendered = state.render()
+        if not state.done():
+            # all runnable workers parked / drained with parked remainder
+            stuck = sorted(state.parked)
+            result.violations.append(Violation(
+                "progress",
+                f"deadlock: worker(s) {stuck} parked with no releasing op "
+                "left in any script",
+                rendered))
+            return  # replay would hang on the parked ops
+        if replay:
+            result.replays += 1
+            _replay_trace(state.trace, factory, rendered, result.violations)
+
+    def budget_left() -> bool:
+        return (result.traces < max_traces
+                and len(result.violations) < max_violations)
+
+    if fuzz_samples > 0:
+        import random
+
+        rng = random.Random(fuzz_seed)
+        seen = set()
+        for _ in range(fuzz_samples):
+            if not budget_left():
+                break
+            state = _TraceState(scripts, ProtocolModel(effects))
+            while True:
+                workers = state.runnable()
+                if not workers:
+                    break
+                state.step(rng.choice(workers))
+            key = state.render()
+            if key in seen:
+                continue
+            seen.add(key)
+            finish(state)
+        return result
+
+    def dfs(state: _TraceState) -> None:
+        if not budget_left():
+            return
+        workers = state.runnable()
+        if not workers:
+            finish(state)
+            return
+        for i, worker in enumerate(workers):
+            branch = state if i == len(workers) - 1 else state.copy()
+            branch.step(worker)
+            dfs(branch)
+            if not budget_left():
+                return
+
+    dfs(_TraceState(scripts, ProtocolModel(effects)))
+    return result
+
+
+# -- default bounded configuration ---------------------------------------------
+
+
+def default_scripts() -> Dict[str, List[ScriptOp]]:
+    """The acceptance configuration: 2 workers, 13 ops including ``batch``,
+    one crash+restart (register takeover), and two duplicate deliveries
+    (an acquire req_id replay and a kv_incr op_id replay)."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("add_tasks", tasks=["t0", "t1", "t2", "t3"]),
+        mk("acquire_task", req_id="w0-a1", worker="w0"),
+        mk("acquire_task", note="dup", req_id="w0-a1", worker="w0"),
+        mk("register", note="restart", takeover=True, worker="w0"),
+        mk("batch", ops=[
+            {"op": "acquire_task", "req_id": "w0-a2"},
+            {"op": "kv_incr", "key": "steps", "delta": 1,
+             "op_id": "w0-i1"},
+        ]),
+        mk("complete_task", task=LAST_TASK, worker="w0"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("acquire_task", req_id="w1-a1", worker="w1"),
+        mk("kv_incr", key="steps", delta=1, op_id="w1-i1"),
+        mk("kv_incr", note="dup", key="steps", delta=1, op_id="w1-i1"),
+        mk("complete_task", task=LAST_TASK, worker="w1"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def load_state_effects(root: str, schema_rel: str = "protocol_schema.json"):
+    """(state_effects dict or None, declared op set or None, error string)."""
+    path = os.path.join(root, schema_rel)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            schema = json.load(f)
+    except OSError:
+        return None, None, f"{schema_rel} is missing"
+    except json.JSONDecodeError as e:
+        return None, None, f"{schema_rel} is not valid JSON: {e}"
+    effects = schema.get("state_effects")
+    ops = set(schema.get("ops", {}))
+    if effects is None:
+        return None, ops, (
+            f"{schema_rel} has no state_effects block — the behavioral "
+            "spec EDL009 model-checks against"
+        )
+    return effects, ops, None
+
+
+def run_default(
+    coordinator_factory: Optional[CoordinatorFactory] = None,
+    effects: Optional[Dict[str, Dict[str, Any]]] = None,
+    fuzz_samples: int = 0,
+    fuzz_seed: int = 0,
+    max_traces: int = 20000,
+    max_violations: int = 25,
+) -> ModelCheckResult:
+    if effects is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        effects, _ops, err = load_state_effects(root)
+        if err:
+            raise ModelCheckError(err)
+    return explore(
+        default_scripts(), effects,
+        coordinator_factory=coordinator_factory,
+        fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
+        max_traces=max_traces, max_violations=max_violations,
+    )
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m edl_tpu.analysis.modelcheck",
+        description=(
+            "Bounded explicit-state model check of the coordinator "
+            "protocol's behavioral spec (protocol_schema.json "
+            "state_effects) against the in-process oracle."
+        ),
+    )
+    parser.add_argument(
+        "--fuzz", type=int, default=0, metavar="N",
+        help="seeded random-walk mode: sample N schedules instead of "
+             "exhaustive DFS (findings are a subset of the exhaustive run)")
+    parser.add_argument(
+        "--seed", type=int, default=0, help="fuzz-mode RNG seed")
+    parser.add_argument(
+        "--max-traces", type=int, default=20000,
+        help="exploration budget (default: 20000)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable result")
+    args = parser.parse_args(argv)
+
+    result = run_default(
+        fuzz_samples=args.fuzz, fuzz_seed=args.seed,
+        max_traces=args.max_traces,
+    )
+    if args.json:
+        print(json.dumps({
+            "traces": result.traces,
+            "replays": result.replays,
+            "violations": [
+                {"kind": v.kind, "message": v.message, "trace": v.trace}
+                for v in result.violations
+            ],
+        }, indent=2))
+    else:
+        mode = f"fuzz({args.fuzz}, seed={args.seed})" if args.fuzz else "exhaustive"
+        print(
+            f"modelcheck [{mode}]: {result.traces} trace(s) explored, "
+            f"{result.replays} replayed against InProcessCoordinator, "
+            f"{len(result.violations)} violation(s)"
+        )
+        for v in result.violations:
+            print(f"  [{v.kind}] {v.message}")
+            print(f"    trace: {v.trace}")
+    return 0 if result.ok() else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

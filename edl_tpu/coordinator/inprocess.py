@@ -44,6 +44,10 @@ class InProcessCoordinator:
         self._sync_arrived: Set[str] = set()
         self._sync_generation = 0
         self._kv: Dict[str, str] = {}
+        # Test-only mutation hook: EDL009's model checker flips this on a
+        # deliberately-broken twin to prove a dedup regression is caught.
+        # Never set outside tests.
+        self._test_disable_dedup = False
         # Native-parity status counters. fsync/snapshot/journal counters stay
         # zero (there is no journal in-process) but the fields must exist so
         # status replies are field-identical across backends (EDL007).
@@ -186,7 +190,7 @@ class InProcessCoordinator:
             self._tick()
             # Dedup (native parity): a retried acquire with the same req_id
             # returns the existing lease instead of popping a second task.
-            if req_id:
+            if req_id and not self._test_disable_dedup:
                 cached = self._acquire_cache.get(worker)
                 if cached and cached[0] == req_id:
                     lease = self._leased.get(cached[1])
@@ -343,7 +347,7 @@ class InProcessCoordinator:
             if not key:
                 return {"ok": False, "error": "key required"}
             marker = f"__edl_op/{op_id}" if op_id else None
-            if marker and marker in self._kv:
+            if marker and marker in self._kv and not self._test_disable_dedup:
                 return {"ok": True, "value": int(self._kv[marker]),
                         "duplicate": True}
             try:

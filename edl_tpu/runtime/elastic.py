@@ -123,7 +123,7 @@ def heartbeat_schedule(worker: str, base: float, jitter: float,
     different across names, so a fleet de-correlates without coordination.
     Exposed for tests and capacity planning.
     """
-    rng = random.Random(f"edl-hb:{worker}")
+    rng = random.Random(f"edl-hb:{worker}")  # edl: noqa[EDL008] heartbeat jitter, not training state — per-worker decorrelation is the point
     return [max(0.0, base * (1.0 + jitter * (2.0 * rng.random() - 1.0)))
             for _ in range(n)]
 
@@ -184,7 +184,7 @@ class ElasticWorker:
         #: per-worker seeded jitter stream (satellite of the control-plane
         #: scale work): each beat draws its own interval so the fleet's
         #: heartbeats de-correlate instead of arriving in phase-locked waves.
-        self._hb_rng = random.Random(f"edl-hb:{self.client.worker}")
+        self._hb_rng = random.Random(f"edl-hb:{self.client.worker}")  # edl: noqa[EDL008] control-plane timing jitter, never touches model/optimizer state
         self._hb_interval = self._next_hb_interval()
         #: heartbeats satisfied from a piggybacked membership observation
         #: (no dedicated RPC issued).

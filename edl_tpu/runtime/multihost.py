@@ -126,7 +126,7 @@ class MultiHostWorker:
         #: from it so a gang of 10k processes sharing one config template
         #: de-correlates instead of hammering the coordinator in phase
         #: (same scheme as ElasticWorker — see elastic.heartbeat_schedule).
-        self._hb_rng = random.Random(f"edl-hb:{self.client.worker}")
+        self._hb_rng = random.Random(f"edl-hb:{self.client.worker}")  # edl: noqa[EDL008] control-plane timing jitter, never touches model/optimizer state
         self._next_hb = 0.0
         #: heartbeats satisfied from a piggybacked membership observation.
         self.hb_coalesced = 0

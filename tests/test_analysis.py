@@ -612,12 +612,12 @@ def test_cli_parse_error_exits_two(tmp_path, capsys):
     assert rc == 2
 
 
-def test_cli_list_rules_names_all_seven(capsys):
+def test_cli_list_rules_names_all_nine(capsys):
     rc = cli_main(["--list-rules"])
     out = capsys.readouterr().out
     assert rc == 0
     for rule in ("EDL001", "EDL002", "EDL003", "EDL004", "EDL005",
-                 "EDL006", "EDL007"):
+                 "EDL006", "EDL007", "EDL008", "EDL009"):
         assert rule in out
 
 
@@ -1158,14 +1158,18 @@ def test_write_protocol_cli_round_trip(tmp_path, monkeypatch, capsys):
 
 def test_repo_protocol_schema_matches_native_source():
     """The committed artifact IS the extraction of the committed .cc — the
-    ratchet's premise. Fails whenever one is edited without the other."""
+    ratchet's premise. Fails whenever one is edited without the other.
+    ``state_effects`` is the hand-authored EDL009 behavioral annotation,
+    not part of the extraction; it must exist and cover the op set."""
     cc = (REPO_ROOT / "native" / "coordinator" / "coordinator.cc").read_text()
     committed = json.loads((REPO_ROOT / "protocol_schema.json").read_text())
+    effects = committed.pop("state_effects")
     assert committed == extract_native_schema(
         cc, "native/coordinator/coordinator.cc"
     )
     assert len(committed["ops"]) >= 18
     assert committed["epoch_stamped"] is True
+    assert set(effects) == set(committed["ops"])
 
 
 # -- parallel engine -----------------------------------------------------------
@@ -1188,3 +1192,341 @@ def test_report_carries_per_rule_timings(tmp_path):
     report = analyze([str(tmp_path)], root=str(tmp_path), rules=["EDL005"])
     assert "EDL005" in report.timings
     assert report.timings["EDL005"] >= 0.0
+
+
+# -- EDL008: elastic determinism -----------------------------------------------
+
+_EDL008_CONFIG = {"edl008_all_files": True}
+
+
+def test_edl008_flags_rng_seeded_from_process_index(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(jax.process_index())
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert rules_of(report) == ["EDL008"]
+    (f,) = report.findings
+    assert "process_index" in f.message and f.symbol.endswith("make_key")
+
+
+def test_edl008_tracks_taint_through_assignment_and_fstring(tmp_path):
+    """The live-tree shape: identity -> f-string -> seed string -> RNG."""
+    report = check(
+        tmp_path,
+        """
+        import random
+        import socket
+
+        def make_rng():
+            host = socket.gethostname()
+            seed_str = f"trainer:{host}"
+            return random.Random(seed_str)
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert rules_of(report) == ["EDL008"]
+    assert "gethostname" in report.findings[0].message
+
+
+def test_edl008_flags_worker_identity_attribute(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import random
+
+        class Loader:
+            def __init__(self, client):
+                self.rng = random.Random(f"shuffle:{client.worker}")
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert rules_of(report) == ["EDL008"]
+    assert "'worker'" in report.findings[0].message
+
+
+def test_edl008_accepts_logical_seed_derivation(tmp_path):
+    """Seeds from config values, shard indices, and step counters are the
+    sanctioned pattern and must not fire."""
+    report = check(
+        tmp_path,
+        """
+        import random
+
+        import jax
+        import numpy as np
+
+        def make_keys(config, shard_index, step):
+            base = jax.random.PRNGKey(config.seed)
+            k = jax.random.fold_in(base, step)
+            rng = np.random.default_rng((config.seed ^ shard_index) & 0xFF)
+            shuffle = random.Random(config.shuffle_seed + shard_index)
+            return k, rng, shuffle
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert report.findings == []
+
+
+def test_edl008_flags_accumulation_over_set_iteration(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def total_loss(losses):
+            pending = set(losses)
+            total = 0.0
+            for item in pending:
+                total += item.loss
+            return total
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert rules_of(report) == ["EDL008"]
+    assert "order varies" in report.findings[0].message
+    assert "'total'" in report.findings[0].message
+
+
+def test_edl008_flags_accumulation_over_membership_values(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        class Aggregator:
+            def grad_norm(self):
+                norm = 0.0
+                for shard in self._members.values():
+                    norm = norm + shard.sq()
+                return norm
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert rules_of(report) == ["EDL008"]
+    assert "_members.values()" in report.findings[0].message
+
+
+def test_edl008_accepts_sorted_and_list_iteration(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        def totals(shards, members):
+            total = 0.0
+            for s in sorted(set(shards)):
+                total += s.loss
+            for name in sorted(members.values()):
+                total += len(name)
+            for s in shards:
+                total += s.weight
+            return total
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert report.findings == []
+
+
+def test_edl008_scope_defaults_to_training_surface(tmp_path):
+    """Outside runtime//parallel//models/ the rule is silent without the
+    edl008_all_files override."""
+    bad = """
+    import jax
+
+    def make_key():
+        return jax.random.PRNGKey(jax.process_index())
+    """
+    silent = check(tmp_path, bad, ["EDL008"], name="tools.py")
+    assert silent.findings == []
+    (tmp_path / "edl_tpu" / "runtime").mkdir(parents=True)
+    scoped = check(
+        tmp_path, bad, ["EDL008"], name="edl_tpu/runtime/loader.py"
+    )
+    assert rules_of(scoped) == ["EDL008"]
+
+
+def test_edl008_respects_line_noqa(tmp_path):
+    report = check(
+        tmp_path,
+        """
+        import random
+
+        def jitter(worker):
+            return random.Random(f"hb:{worker}")  # edl: noqa[EDL008] heartbeat jitter, not training state
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert report.findings == []
+    assert [f.rule for f in report.suppressed] == ["EDL008"]
+
+
+# -- EDL009: protocol model check ----------------------------------------------
+
+
+def test_edl009_green_on_the_real_coordinator():
+    report = analyze(
+        [str(REPO_ROOT / "edl_tpu" / "coordinator" / "inprocess.py")],
+        root=str(REPO_ROOT),
+        rules=["EDL009"],
+    )
+    assert report.findings == []
+
+
+def test_edl009_skips_trees_without_the_oracle_module(tmp_path):
+    """Fixture trees never pay the exploration cost: no target file, no
+    reduce work, no findings."""
+    report = check(tmp_path, "x = 1\n", ["EDL009"])
+    assert report.findings == []
+
+
+def test_edl009_reports_state_effects_coverage_drift(tmp_path):
+    """An op in the dispatch table without a state_effects entry (and vice
+    versa) is a finding on the schema artifact."""
+    target = tmp_path / "edl_tpu" / "coordinator"
+    target.mkdir(parents=True)
+    (target / "inprocess.py").write_text("x = 1\n")
+    (tmp_path / "protocol_schema.json").write_text(json.dumps({
+        "ops": {"ping": {}, "register": {}},
+        "state_effects": {"ping": {}, "vanished_op": {}},
+    }))
+    report = analyze(
+        [str(target / "inprocess.py")], root=str(tmp_path), rules=["EDL009"]
+    )
+    messages = sorted(f.message for f in report.findings)
+    assert len(messages) == 2
+    assert "register" in messages[0] and "no state_effects entry" in messages[0]
+    assert "vanished_op" in messages[1] and "stale" in messages[1]
+    assert all(f.path == "protocol_schema.json" for f in report.findings)
+
+
+def test_edl009_reports_missing_state_effects_block(tmp_path):
+    target = tmp_path / "edl_tpu" / "coordinator"
+    target.mkdir(parents=True)
+    (target / "inprocess.py").write_text("x = 1\n")
+    (tmp_path / "protocol_schema.json").write_text(json.dumps({"ops": {}}))
+    report = analyze(
+        [str(target / "inprocess.py")], root=str(tmp_path), rules=["EDL009"]
+    )
+    (f,) = report.findings
+    assert "state_effects" in f.message
+
+
+# -- SARIF output ---------------------------------------------------------------
+
+
+def test_sarif_round_trip_on_known_findings(tmp_path):
+    from edl_tpu.analysis.sarif import from_sarif, to_sarif
+
+    report = check(
+        tmp_path,
+        """
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(jax.process_index())
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    assert report.findings
+    doc = to_sarif(report.findings, baselined=[])
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert any(r["id"] == "EDL009" for r in run["tool"]["driver"]["rules"])
+    new, baselined = from_sarif(json.loads(json.dumps(doc)))
+    assert baselined == []
+    assert new == report.findings
+
+
+def test_sarif_marks_baselined_findings_as_suppressed(tmp_path):
+    from edl_tpu.analysis.sarif import from_sarif, to_sarif
+
+    report = check(
+        tmp_path,
+        """
+        def total(pending):
+            total = 0.0
+            for item in set(pending):
+                total += item
+            return total
+        """,
+        ["EDL008"],
+        config=_EDL008_CONFIG,
+    )
+    doc = to_sarif([], baselined=report.findings)
+    result = doc["runs"][0]["results"][0]
+    assert result["suppressions"][0]["kind"] == "external"
+    assert result["partialFingerprints"]["edlFingerprint/v1"] == fingerprint(
+        report.findings[0]
+    )
+    new, baselined = from_sarif(doc)
+    assert new == [] and baselined == report.findings
+
+
+def test_cli_format_sarif(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("def f():\n    return 1\n")
+    rc = cli_main(["--format", "sarif", "--baseline", "none", str(clean)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []
+
+
+# -- parallel parity / reduce timings for the new program rules -----------------
+
+
+def test_new_program_rules_jobs_parity(tmp_path):
+    """EDL008 map/reduce across a process pool produces byte-identical
+    findings to the serial path (EDL009 has no target file here and must
+    stay silent in both)."""
+    bad = textwrap.dedent(
+        """
+        import jax
+
+        def make_key():
+            return jax.random.PRNGKey(jax.process_index())
+        """
+    )
+    for i in range(4):
+        (tmp_path / f"mod{i}.py").write_text(bad)
+    kw = dict(
+        root=str(tmp_path),
+        rules=["EDL008", "EDL009"],
+        config=_EDL008_CONFIG,
+    )
+    serial = analyze([str(tmp_path)], jobs=1, **kw)
+    forked = analyze([str(tmp_path)], jobs=2, **kw)
+    as_tuples = lambda r: [  # noqa: E731
+        (f.path, f.line, f.col, f.rule, f.message) for f in r.findings
+    ]
+    assert as_tuples(serial) == as_tuples(forked)
+    assert len(serial.findings) == 4
+    assert serial.jobs == 1 and forked.jobs == 2
+
+
+def test_edl009_jobs_parity_on_the_real_tree():
+    coord = str(REPO_ROOT / "edl_tpu" / "coordinator")
+    kw = dict(root=str(REPO_ROOT), rules=["EDL009"])
+    serial = analyze([coord], jobs=1, **kw)
+    forked = analyze([coord], jobs=2, **kw)
+    assert serial.findings == forked.findings == []
+
+
+def test_report_splits_reduce_timings_from_map_timings(tmp_path):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    report = analyze(
+        [str(tmp_path)], root=str(tmp_path), rules=["EDL005", "EDL008"]
+    )
+    assert "EDL005" in report.timings
+    assert "EDL005" not in report.reduce_timings  # file rules never reduce
+    assert "EDL008" in report.reduce_timings
+    assert report.reduce_timings["EDL008"] >= 0.0

@@ -1,0 +1,239 @@
+"""Tests for the EDL009 protocol model checker (edl_tpu.analysis.modelcheck).
+
+Layers:
+
+- the acceptance configuration: exhaustive DFS over the default 2-worker
+  faulty schedule (crash+restart, duplicate acquire, duplicate kv_incr, a
+  batch frame) is green, every trace replayed against InProcessCoordinator;
+- teeth: a deliberately mutated twin (request dedup disabled via the
+  test-only ``_test_disable_dedup`` flag) is caught, through both the
+  model/oracle divergence and the exactly-once monitor;
+- the fuzz mode's soundness contract: any violation the seeded random walk
+  reports is also reported by the exhaustive run at the same depth;
+- parked-op handling: barrier/sync release and bounded-progress deadlock
+  detection.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from edl_tpu.analysis.modelcheck import (
+    LAST_TASK,
+    ModelCheckError,
+    ProtocolModel,
+    ScriptOp,
+    default_scripts,
+    explore,
+    load_state_effects,
+    main as modelcheck_main,
+    run_default,
+)
+
+mk = ScriptOp.make
+
+REPO_ROOT = str(Path(__file__).resolve().parents[1])
+
+
+def _mutant_factory():
+    """The deliberately broken twin: replay dedup disabled. Duplicate
+    acquire req_ids hand out a second task; duplicate kv_incr op_ids
+    double-apply."""
+    from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+    c = InProcessCoordinator(task_lease_sec=1e9, heartbeat_ttl_sec=1e9)
+    c._test_disable_dedup = True
+    return c
+
+
+def _effects():
+    effects, ops, err = load_state_effects(REPO_ROOT)
+    assert err is None, err
+    return effects
+
+
+# -- the acceptance configuration ----------------------------------------------
+
+
+def test_default_exhaustive_is_green_and_fully_replayed():
+    """2 workers, 13 ops incl. batch, crash+restart, two duplicate
+    deliveries: every interleaving model-checked AND oracle-replayed,
+    zero violations, comfortably under the 60 s budget."""
+    t0 = time.monotonic()
+    result = run_default()
+    elapsed = time.monotonic() - t0
+    assert result.violations == []
+    # C(13, 6) interleavings of the two scripts
+    assert result.traces == 1716
+    assert result.replays == result.traces
+    assert result.ok()
+    assert elapsed < 60.0
+
+
+def test_default_scripts_meet_the_bounded_config_contract():
+    scripts = default_scripts()
+    assert set(scripts) == {"w0", "w1"}
+    ops = [op.op for s in scripts.values() for op in s]
+    assert len(ops) >= 6 and "batch" in ops
+    notes = [op.note for s in scripts.values() for op in s]
+    assert "restart" in notes  # crash+restart
+    assert notes.count("dup") == 2  # duplicate deliveries
+
+
+def test_state_effects_cover_the_full_op_set():
+    effects, ops, err = load_state_effects(REPO_ROOT)
+    assert err is None
+    assert set(effects) == ops
+    assert len(ops) >= 18
+
+
+# -- teeth: the mutated twin ----------------------------------------------------
+
+
+def test_mutant_twin_with_dedup_disabled_is_caught():
+    result = run_default(coordinator_factory=_mutant_factory,
+                         max_violations=10)
+    assert result.violations, "mutant twin must not pass"
+    kinds = {v.kind for v in result.violations}
+    # the duplicate acquire shows up both as a model/oracle reply
+    # divergence and as a second grant for the same req_id
+    assert kinds & {"oracle-divergence", "exactly-once"}
+
+
+def test_mutant_violation_messages_name_the_replayed_request():
+    result = run_default(coordinator_factory=_mutant_factory,
+                         max_violations=50)
+    blob = " ".join(v.message for v in result.violations)
+    assert "w0-a1" in blob or "w1-i1" in blob or "duplicate" in blob
+
+
+# -- fuzz mode ------------------------------------------------------------------
+
+
+def test_fuzz_on_green_twin_stays_green():
+    result = run_default(fuzz_samples=40, fuzz_seed=7)
+    assert result.violations == []
+    assert 0 < result.traces <= 40  # identical schedules dedup
+    assert result.replays == result.traces
+
+
+def test_fuzz_findings_are_subset_of_exhaustive_at_equal_depth():
+    """The soundness contract of --fuzz: same per-trace checking, sampled
+    schedule set — so on the mutant twin every fuzz violation key appears
+    in the exhaustive run's violation set."""
+    exhaustive = run_default(coordinator_factory=_mutant_factory,
+                             max_violations=10 ** 6)
+    fuzz = run_default(coordinator_factory=_mutant_factory,
+                       fuzz_samples=30, fuzz_seed=3,
+                       max_violations=10 ** 6)
+    assert fuzz.violations, "fuzz must hit the planted bug at this budget"
+    assert fuzz.violation_keys() <= exhaustive.violation_keys()
+    assert len(exhaustive.violation_keys()) > len(fuzz.violation_keys())
+
+
+def test_fuzz_is_deterministic_per_seed():
+    a = run_default(fuzz_samples=25, fuzz_seed=11)
+    b = run_default(fuzz_samples=25, fuzz_seed=11)
+    assert a.traces == b.traces
+    assert a.violation_keys() == b.violation_keys()
+
+
+# -- parked ops: barrier / sync -------------------------------------------------
+
+
+def _barrier_scripts(count):
+    return {
+        "w0": [mk("register", worker="w0"),
+               mk("barrier", name="b", count=count, worker="w0")],
+        "w1": [mk("register", worker="w1"),
+               mk("barrier", name="b", count=count, worker="w1")],
+    }
+
+
+def test_barrier_release_explored_and_green():
+    result = explore(_barrier_scripts(count=2), _effects())
+    assert result.traces == 6  # C(4, 2) interleavings
+    assert result.violations == []
+    assert result.replays == result.traces
+
+
+def test_unsatisfiable_barrier_is_a_progress_violation():
+    """count=3 with two workers: every complete interleaving deadlocks, and
+    the model reports it WITHOUT replaying (replay would hang)."""
+    result = explore(_barrier_scripts(count=3), _effects())
+    assert result.traces == 6
+    assert result.violations
+    assert {v.kind for v in result.violations} == {"progress"}
+    assert result.replays == 0
+
+
+def test_sync_parking_detects_the_stranded_worker():
+    """sync(epoch=2) issued before the second register gets an immediate
+    resync and drains; interleavings where it parks after both registers
+    but the peer already drained deadlock — the checker must see exactly
+    those."""
+    scripts = {
+        "w0": [mk("register", worker="w0"),
+               mk("sync", epoch=2, worker="w0")],
+        "w1": [mk("register", worker="w1"),
+               mk("sync", epoch=2, worker="w1")],
+    }
+    result = explore(scripts, _effects())
+    assert result.traces == 6
+    deadlocks = [v for v in result.violations if v.kind == "progress"]
+    assert len(deadlocks) == 2
+    assert len(result.violations) == 2  # nothing besides the deadlocks
+
+
+# -- model plumbing -------------------------------------------------------------
+
+
+def test_scriptop_make_freezes_nested_fields():
+    op = mk("batch", ops=[{"op": "ping"}], worker="w0")
+    assert isinstance(op.fields, tuple)
+    d = op.field_dict()
+    assert d["ops"] == [{"op": "ping"}]
+    assert hash(op) is not None  # frozen dataclass stays hashable
+
+
+def test_unknown_effect_tag_is_a_spec_error_not_a_violation():
+    effects = dict(_effects())
+    effects["ping"] = {"quantum": "entangle"}
+    with pytest.raises(ModelCheckError):
+        ProtocolModel(effects)
+
+
+def test_load_state_effects_reports_missing_block(tmp_path):
+    (tmp_path / "protocol_schema.json").write_text(
+        json.dumps({"ops": {"ping": {}}})
+    )
+    effects, ops, err = load_state_effects(str(tmp_path))
+    assert effects is None
+    assert ops == {"ping"}
+    assert "state_effects" in err
+
+
+def test_load_state_effects_reports_missing_file(tmp_path):
+    effects, ops, err = load_state_effects(str(tmp_path))
+    assert effects is None and ops is None
+    assert "missing" in err
+
+
+# -- CLI ------------------------------------------------------------------------
+
+
+def test_cli_exhaustive_exits_zero(capsys):
+    rc = modelcheck_main([])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1716 trace(s)" in out and "0 violation(s)" in out
+
+
+def test_cli_json_fuzz(capsys):
+    rc = modelcheck_main(["--fuzz", "10", "--seed", "5", "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert payload["violations"] == []
+    assert payload["replays"] == payload["traces"] > 0
