@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py
 
-.PHONY: analyze analyze-json baseline test chaos lint obs-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective
+.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -25,6 +25,15 @@ test:
 ## process-kill soaks tier-1 skips.
 chaos:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m chaos
+
+## Composed cross-axis chaos: trainer SIGKILL x apiserver 409/410 x
+## coordinator partitions, overlapping under one scripted ChaosScenario.
+## Exercises the adaptive fault-tolerance policy end to end (blips
+## reconnect in place, the storm checkpoint-and-parks) — see
+## doc/robustness.md. Sanitizer-compatible: run with
+## EDL_COORD_SANITIZER=tsan to put the native coordinator under TSan.
+chaos-composed:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos_composed.py -q -m chaos
 
 ## Telemetry-plane deploy gate: boots a worker with its /metrics endpoint
 ## against a real coordinator, scrapes over HTTP while training runs, and
@@ -58,7 +67,8 @@ tsan-smoke:
 
 ## Everything a PR must pass: static analysis (EDL001-EDL009 vs baseline +
 ## protocol_schema.json ratchet), tier-1 tests, protocol model check,
-## TSan lane.
+## TSan lane. Tier-2 (slow, run before cutting a release): `make chaos`
+## and `make chaos-composed` — the soaks and the composed cross-axis run.
 verify: analyze test modelcheck tsan-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates

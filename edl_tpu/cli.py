@@ -217,11 +217,25 @@ def cmd_status(args) -> int:
         )
         with client:
             status = client.call("status", timeout=args.timeout)
+            # Workers publish their live fault-tolerance policy state under
+            # edl/ft_policy/<worker> (runtime.ft_policy); read it back per
+            # member. Best-effort: an old coordinator without members/kv
+            # just shows no policy section.
+            policies = {}
+            try:
+                for member in client.members():
+                    raw = client.kv_get(f"edl/ft_policy/{member}")
+                    if raw:
+                        policies[member] = json.loads(raw)
+            except (CoordinatorError, ValueError):
+                policies = {}
     except (CoordinatorError, OSError) as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
     ok = bool(status.get("ok"))
     if args.json:
+        if policies:
+            status = dict(status, ft_policy=policies)
         print(json.dumps(status, indent=2, sort_keys=True))
         return 0 if ok else 1
     counters = [
@@ -245,6 +259,14 @@ def cmd_status(args) -> int:
         for item in holders:
             worker, _, count = str(item).rpartition("=")
             print(f"    {worker:<24} {count}")
+    if policies:
+        print("  fault-tolerance policy:")
+        for worker, st in sorted(policies.items()):
+            print(f"    {worker:<24} policy={st.get('policy')} "
+                  f"mode={st.get('mode')} "
+                  f"threshold={st.get('threshold')}s "
+                  f"incidents={st.get('incidents')} "
+                  f"storm={st.get('storm')}")
     return 0 if ok else 1
 
 

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from edl_tpu.coordinator.client import (
     CoordinatorAuthError,
@@ -159,6 +159,13 @@ class OutboxClient:
         self.replayed_ops = 0
         self.outages = 0
         self.outage_total_seconds = 0.0
+        #: called with the incident's duration (seconds) each time an
+        #: outage closes — the running total above aggregates per-incident
+        #: lengths away, and both the outage-duration histogram and the
+        #: adaptive fault-tolerance policy need the distribution. Invoked
+        #: from whichever thread's guarded call observed recovery; keep
+        #: the callback cheap and thread-safe.
+        self.on_outage_close: Optional[Callable[[float], None]] = None
 
     # -- outage accounting -----------------------------------------------------
 
@@ -182,8 +189,11 @@ class OutboxClient:
 
     def _mark_up(self) -> None:
         if self.unreachable_since is not None:
-            self.outage_total_seconds += time.monotonic() - self.unreachable_since
+            duration = time.monotonic() - self.unreachable_since
+            self.outage_total_seconds += duration
             self.unreachable_since = None
+            if self.on_outage_close is not None:
+                self.on_outage_close(duration)
 
     def replay(self) -> int:
         """Drain the outbox through the underlying client (idempotent)."""

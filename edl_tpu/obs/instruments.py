@@ -14,7 +14,13 @@ from typing import Optional
 
 from edl_tpu.obs.metrics import MetricsRegistry, get_registry
 
-__all__ = ["WorkerInstruments"]
+__all__ = ["WorkerInstruments", "FTPolicyInstruments", "OUTAGE_BUCKETS"]
+
+#: outage-duration buckets: sub-second blips through multi-minute storms.
+#: The default latency buckets top out at 60 s — exactly where the park
+#: decision gets interesting — so outages get their own scale.
+OUTAGE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                  120.0, 300.0, 600.0)
 
 
 class WorkerInstruments:
@@ -44,6 +50,13 @@ class WorkerInstruments:
         self.outage_seconds_total = r.gauge(
             "edl_worker_outage_seconds_total",
             "cumulative seconds spent with the coordinator unreachable",
+        )
+        self.outage_duration = r.histogram(
+            "edl_worker_outage_duration_seconds",
+            "per-incident coordinator outage lengths (the distribution the "
+            "adaptive fault-tolerance policy sizes its wait window from; "
+            "the running-total gauge loses exactly this)",
+            buckets=OUTAGE_BUCKETS,
         )
         self.epoch = r.gauge(
             "edl_worker_epoch",
@@ -98,3 +111,49 @@ class WorkerInstruments:
     def note_epoch(self, epoch: int) -> None:
         self.epoch.set(float(epoch))
         self.epoch_observations.inc()
+
+
+class FTPolicyInstruments:
+    """The fault-tolerance policy engine's audit surface: which mode was
+    chosen, how often, and the live inputs the choice was computed from.
+    One scrape answers "why did this worker park?"."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.decisions = r.counter(
+            "edl_ft_policy_decisions_total",
+            "recovery-mode decisions taken, by mode",
+            labelnames=("mode",),  # wait | reconnect | warm_restart | park
+        )
+        self.mode = r.gauge(
+            "edl_ft_policy_mode",
+            "last decided recovery mode "
+            "(0=wait 1=reconnect 2=warm_restart 3=park)",
+        )
+        self.incidents = r.counter(
+            "edl_ft_policy_incidents_total",
+            "coordinator-outage incidents the policy adjudicated",
+        )
+        self.park_threshold = r.gauge(
+            "edl_ft_policy_park_threshold_seconds",
+            "escalation threshold (frozen per incident; the static budget "
+            "until min_history incidents close)",
+        )
+        self.outage_quantile = r.gauge(
+            "edl_ft_policy_outage_quantile_seconds",
+            "residual quantile of the closed-incident outage durations",
+        )
+        self.checkpoint_cost = r.gauge(
+            "edl_ft_policy_checkpoint_cost_seconds",
+            "EMA of measured durable-checkpoint cost (park break-even input)",
+        )
+        self.restep_cost = r.gauge(
+            "edl_ft_policy_restep_cost_seconds",
+            "live cost of re-training steps since the last durable "
+            "checkpoint (steps x step-seconds EMA)",
+        )
+        self.failure_rate = r.gauge(
+            "edl_ft_policy_failure_rate_per_min",
+            "closed incidents per minute over the trailing window "
+            "(storm detector input)",
+        )

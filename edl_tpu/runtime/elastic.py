@@ -23,6 +23,8 @@ mimicking trainers joining a slice.
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import logging
 import random
 import time
@@ -39,8 +41,13 @@ from edl_tpu.obs.tracing import Tracer, get_tracer, rescale_trace_id
 from edl_tpu.parallel.mesh import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.data import LeaseReader, split_pass
+from edl_tpu.runtime.ft_policy import PARK, FTPolicy, FTPolicyConfig
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
 from edl_tpu.runtime.wire import WireRestartRequired
+
+#: coordinator KV key a worker publishes its live policy state under;
+#: `edl-tpu status` enumerates members and reads these back.
+FT_POLICY_KEY = "edl/ft_policy/{worker}"
 
 log = logging.getLogger("edl_tpu.runtime.elastic")
 
@@ -93,12 +100,54 @@ class ElasticConfig:
     #: and parks, polling for the coordinator's return. See
     #: doc/robustness.md for the full failure model.
     outage_budget: float = 60.0
+    #: fault-tolerance policy mode: ``adaptive`` sizes the park decision
+    #: per incident from live outage statistics and measured recovery
+    #: costs (`runtime.ft_policy`); ``static`` pins it to the fixed
+    #: ``outage_budget`` threshold above — the pre-policy semantics.
+    policy: str = "adaptive"
+    #: full policy knobs; None derives FTPolicyConfig(policy=policy,
+    #: outage_budget=outage_budget) with the documented defaults.
+    ft_policy: Optional[FTPolicyConfig] = None
     #: serve ``/metrics`` + ``/healthz`` + ``/spans`` from this worker
     #: process on the given port (0 = ephemeral); None disables. The
     #: endpoint also bridges the coordinator's status counters, so one
     #: scrape of any worker sees control plane and data plane together.
     metrics_port: Optional[int] = None
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
+
+    def __post_init__(self) -> None:
+        # Fail at construction, not an hour into the job: a negative
+        # outage_budget silently turned every blip into a park, a negative
+        # heartbeat interval spins the beat loop hot — both were accepted
+        # without complaint before this check.
+        if self.heartbeat_interval < 0:
+            raise ValueError(
+                f"ElasticConfig.heartbeat_interval must be >= 0 seconds "
+                f"(0 beats every loop iteration), got {self.heartbeat_interval!r}")
+        if not 0.0 <= self.heartbeat_jitter <= 1.0:
+            raise ValueError(
+                f"ElasticConfig.heartbeat_jitter is a ± fraction of the "
+                f"interval and must be in [0, 1], got {self.heartbeat_jitter!r}")
+        if self.outage_budget <= 0:
+            raise ValueError(
+                f"ElasticConfig.outage_budget must be > 0 seconds (it is "
+                f"the park threshold ceiling), got {self.outage_budget!r}")
+        if self.rescale_barrier_timeout <= 0:
+            raise ValueError(
+                f"ElasticConfig.rescale_barrier_timeout must be > 0 "
+                f"seconds, got {self.rescale_barrier_timeout!r}")
+        if self.checkpoint_interval < 1:
+            raise ValueError(
+                f"ElasticConfig.checkpoint_interval must be >= 1 step, "
+                f"got {self.checkpoint_interval!r}")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"ElasticConfig.pipeline_depth must be >= 0 "
+                f"(0 places synchronously), got {self.pipeline_depth!r}")
+        if self.policy not in ("adaptive", "static"):
+            raise ValueError(
+                f"ElasticConfig.policy must be 'adaptive' or 'static', "
+                f"got {self.policy!r}")
 
 
 def default_device_planner(chips_per_trainer: int) -> Callable[[int], Sequence[jax.Device]]:
@@ -172,6 +221,22 @@ class ElasticWorker:
         #: membership epoch (obs.tracing.rescale_trace_id).
         self.tracer = tracer if tracer is not None else get_tracer()
         self.obs = WorkerInstruments()
+        #: per-incident recovery-mode selector (doc/robustness.md, policy
+        #: layer): replaces the fixed outage_budget comparison with a
+        #: threshold computed from the live outage distribution and
+        #: measured checkpoint/restore/re-step costs. ``policy="static"``
+        #: pins it back to the old semantics.
+        self.policy = FTPolicy(
+            config.ft_policy if config.ft_policy is not None
+            else FTPolicyConfig(policy=config.policy,
+                                outage_budget=config.outage_budget),
+            worker=self.client.worker,
+            tracer=self.tracer,
+        )
+        #: transport retry policy at construction — the regime baseline the
+        #: storm deadline override is computed from and restored to.
+        self._default_retry = None
+        self.client.on_outage_close = self._on_outage_close
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.rescales: List[RescaleEvent] = []
         self.steps_done = 0
@@ -217,6 +282,46 @@ class ElasticWorker:
         #: what rescale warm-compile specializes the new mesh's step
         #: against. Written once from whichever thread places first.
         self._batch_avals: Optional[Dict[str, jax.ShapeDtypeStruct]] = None
+
+    # -- fault-tolerance policy plumbing ----------------------------------------
+
+    def _on_outage_close(self, duration: float) -> None:
+        """OutboxClient callback: one outage incident ended. Feeds the
+        per-incident duration (the histogram the running-total gauge loses)
+        and the policy's history, then re-applies the regime's transport
+        deadline. Runs on whichever thread's guarded call observed
+        recovery — everything here is thread-safe and cheap."""
+        self.obs.outage_duration.observe(duration)
+        self.policy.note_outage_closed(duration)
+        self._apply_retry_deadline()
+        self._publish_policy_state()
+
+    def _apply_retry_deadline(self) -> None:
+        """Storm regime: shorten the transport's retry deadline so calls
+        fail fast into degraded mode instead of spending the policy's wait
+        window inside one RPC's retry loop; restore the construction-time
+        default when the regime calms."""
+        raw = getattr(self.client, "client", self.client)
+        retry = getattr(raw, "retry", None)
+        if retry is None or not hasattr(retry, "deadline"):
+            return  # in-process clients have no transport retry loop
+        if self._default_retry is None:
+            self._default_retry = retry
+        want = self.policy.retry_deadline()
+        raw.retry = (dataclasses.replace(self._default_retry, deadline=want)
+                     if want is not None else self._default_retry)
+
+    def _publish_policy_state(self) -> None:
+        """Push the policy's auditable state to the coordinator KV — a
+        guarded mutation, so it buffers through the outbox during the very
+        outages it describes and lands on replay. `edl-tpu status` reads
+        these keys back per member."""
+        try:
+            self.client.kv_put(
+                FT_POLICY_KEY.format(worker=self.client.worker),
+                json.dumps(self.policy.state()))
+        except Exception:  # edl: noqa[EDL005] telemetry publish is best-effort; policy-state visibility must never take down training
+            log.debug("ft_policy state publish failed", exc_info=True)
 
     # -- membership ------------------------------------------------------------
 
@@ -268,6 +373,14 @@ class ElasticWorker:
                    * (1.0 + self.config.heartbeat_jitter
                       * (2.0 * self._hb_rng.random() - 1.0)))
 
+    def _poll_pause(self, base: float = 0.2) -> None:
+        """Idle-poll sleep from the seeded per-worker jitter stream: a
+        fleet draining the same queue (or the same outage) would otherwise
+        re-poll the coordinator in phase-locked waves — the identical
+        hazard the heartbeat jitter exists for."""
+        time.sleep(max(0.05, base * (1.0 + self.config.heartbeat_jitter
+                                     * (2.0 * self._hb_rng.random() - 1.0))))
+
     def _signal_drain(self) -> bool:
         """Mark the instant the interrupt decision was made (the drain
         span's start — first signal wins: quiesce time is measured from the
@@ -307,10 +420,16 @@ class ElasticWorker:
         if reply.get("unreachable"):
             self._outage_open = True
             outage = self.client.outage_seconds()
-            if outage > self.config.outage_budget:
+            # The policy adjudicates the incident: wait (degraded mode is
+            # free while leased batches last) or escalate to checkpoint-
+            # and-park. The threshold froze when the incident opened, so
+            # this comparison flips at most once per incident.
+            if self.policy.on_outage(outage) == PARK:
                 log.warning(
-                    "coordinator unreachable %.1fs (budget %.1fs): "
-                    "checkpoint-and-park", outage, self.config.outage_budget)
+                    "coordinator unreachable %.1fs (policy threshold %.1fs, "
+                    "policy=%s): checkpoint-and-park", outage,
+                    self.policy.frozen_threshold, self.policy.config.policy)
+                self._publish_policy_state()  # buffered; lands on replay
                 return self._signal_drain()
             return False
         rejoined = False
@@ -321,7 +440,8 @@ class ElasticWorker:
             reply = self.client.register(takeover=False)
             if reply.get("unreachable"):
                 self._outage_open = True
-                if self.client.outage_seconds() > self.config.outage_budget:
+                if self.policy.on_outage(
+                        self.client.outage_seconds()) == PARK:
                     return self._signal_drain()
                 return False
             if not reply.get("ok") or "epoch" not in reply:
@@ -520,7 +640,13 @@ class ElasticWorker:
             reader.take_consumed() if reader is not None else []
         )
         self._carry_consumed = []
+        ck_t0 = time.monotonic()
         self._checkpoint(state, block=block)
+        if block:
+            # Only a blocking save measures durability end-to-end (an async
+            # initiation returns before the bytes land) — that is exactly
+            # the cost the policy's park break-even prices.
+            self.policy.note_checkpoint_cost(time.monotonic() - ck_t0)
         covered = self._pending_commit
         if block:
             covered = covered + consumed
@@ -566,6 +692,7 @@ class ElasticWorker:
             "rank": self._rank,
             "steps": self.steps_done,
             "rescales": len(self.rescales),
+            "ft_policy": self.policy.state(),
         }
 
     def _run(self, max_rescales: int) -> Dict[str, float]:
@@ -602,6 +729,9 @@ class ElasticWorker:
                 codec_channel = KVCodecChannel(self.client, self._epoch)
             trainer = Trainer(self.model, mesh, self.config.trainer,
                               codec_channel=codec_channel)
+            # Live re-step pricing: every completed step feeds its wall
+            # seconds to the policy's EMA (train_loop cost hook).
+            trainer.step_cost_cb = self.policy.note_step
             if self.profiler is not None:
                 # The first step on a fresh mesh recompiles (20-40 s on TPU);
                 # keep it out of steady-state summaries.
@@ -616,6 +746,7 @@ class ElasticWorker:
             state = self._restore_or_init(trainer, fresh=fresh)
             self.tracer.record("restore", t_restore0, time.time(),
                                trace_id=rid, component="worker", world=world)
+            self.policy.note_restore_cost(time.time() - t_restore0)
             compile_seconds = join_warm()
             # first_step measures mesh-ready -> first optimizer step done:
             # the residual cost warm-compile could not hide (dispatch, any
@@ -719,7 +850,7 @@ class ElasticWorker:
                     if self._carry_consumed or self._pending_commit:
                         self._checkpoint_and_commit(state, None, block=True)
                         last_ckpt_step = int(state.step)
-                    time.sleep(0.2)
+                    self._poll_pause()
                     if self._epoch_changed(force=True):
                         rescale = True
                         drain_t0 = self._drain_signal_t or time.time()
@@ -762,7 +893,7 @@ class ElasticWorker:
                 if len(self.client.outbox):
                     self.client.replay()
                 if len(self.client.outbox):
-                    time.sleep(0.2)
+                    self._poll_pause()
             total = time.perf_counter() - t_start
             if self.profiler is not None:
                 prof = {f"profile_{k}": v for k, v in self.profiler.summary().items()}
@@ -772,6 +903,9 @@ class ElasticWorker:
                 log.info("per-pass steps: %s", dict(sorted(self.pass_steps.items())))
             outage = {f"outage_{k}": v for k, v in self.client.summary().items()}
             outage["outage_parks"] = float(self.parks)
+            outage.update({f"policy_{m}": float(n)
+                           for m, n in self.policy.decisions.items()})
+            outage["policy_incidents"] = float(self.policy.incidents)
             return {
                 **prof,
                 **outage,

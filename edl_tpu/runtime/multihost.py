@@ -54,6 +54,7 @@ from edl_tpu.obs.instruments import WorkerInstruments
 from edl_tpu.parallel import MeshSpec, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.elastic import ElasticConfig
+from edl_tpu.runtime.ft_policy import WARM_RESTART, FTPolicy, FTPolicyConfig
 from edl_tpu.runtime.train_loop import Trainer, TrainState
 
 log = logging.getLogger("edl_tpu.runtime.multihost")
@@ -104,6 +105,22 @@ class MultiHostWorker:
         #: same metric families as ElasticWorker — dashboards don't care
         #: which worker flavor a pod runs.
         self.obs = WorkerInstruments()
+        #: per-incident recovery selector. The escalation terminal for a
+        #: lockstep gang is the warm restart (one process cannot park
+        #: alone: peers would hang in the next collective); the wait/
+        #: reconnect half of the ladder is identical to ElasticWorker's.
+        self.policy = FTPolicy(
+            config.ft_policy if config.ft_policy is not None
+            else FTPolicyConfig(policy=config.policy,
+                                outage_budget=config.outage_budget),
+            worker=self.client.worker,
+        )
+
+        def _outage_closed(duration: float) -> None:
+            self.obs.outage_duration.observe(duration)
+            self.policy.note_outage_closed(duration)
+
+        self.client.on_outage_close = _outage_closed
         self.ckpt = Checkpointer(config.checkpoint_dir)
         self.steps_done = 0
         self.losses: List[float] = []
@@ -219,11 +236,13 @@ class MultiHostWorker:
             # this round's key stall on the same signal (their kv_get raises),
             # so lockstep holds; past the budget the whole gang warm-restarts
             # and the completion lag replays anything uncovered.
-            if self.client.outage_seconds() > self.config.outage_budget:
+            if self.policy.on_outage(
+                    self.client.outage_seconds(),
+                    escalate_mode=WARM_RESTART) == WARM_RESTART:
                 log.warning(
-                    "coordinator outage %.1fs exceeded budget %.1fs; "
+                    "coordinator outage %.1fs over policy threshold %.1fs; "
                     "gang restart", self.client.outage_seconds(),
-                    self.config.outage_budget)
+                    self.policy.frozen_threshold)
                 return {"stop": "rescale"}
             self._hb_sleep()
             hb = self.client.heartbeat()
@@ -326,14 +345,24 @@ class MultiHostWorker:
             except CoordinatorError:
                 if down_since is None:
                     down_since = time.monotonic()
-                if time.monotonic() - down_since > self.config.outage_budget:
+                if self.policy.on_outage(
+                        time.monotonic() - down_since,
+                        escalate_mode=WARM_RESTART) == WARM_RESTART:
                     log.warning(
-                        "round %d: coordinator outage exceeded budget %.1fs; "
-                        "assuming rescale", rnd, self.config.outage_budget)
+                        "round %d: coordinator outage over policy threshold "
+                        "%.1fs; assuming rescale", rnd,
+                        self.policy.frozen_threshold)
                     return {"stop": "rescale"}
                 self._hb_sleep()
                 continue
             if down_since is not None:
+                # kv_get is a passthrough (no outbox accounting), so close
+                # the incident here unless a guarded call's on_outage_close
+                # callback already did.
+                if self.policy.incident_open:
+                    duration = time.monotonic() - down_since
+                    self.obs.outage_duration.observe(duration)
+                    self.policy.note_outage_closed(duration)
                 down_since = None
                 deadline = time.monotonic() + timeout
             if raw:
@@ -443,7 +472,9 @@ class MultiHostWorker:
         info = self.client.register(takeover=True)
         while not info.get("ok"):
             if not info.get("unreachable") or (
-                    self.client.outage_seconds() > self.config.outage_budget):
+                    self.policy.on_outage(self.client.outage_seconds(),
+                                          escalate_mode=WARM_RESTART)
+                    == WARM_RESTART):
                 self._exit_for_restart()
             self._hb_sleep()
             info = self.client.register(takeover=True)
@@ -461,9 +492,14 @@ class MultiHostWorker:
             codec_channel = KVCodecChannel(self.client, epoch)
         trainer = Trainer(self.model, mesh, self.config.trainer,
                           codec_channel=codec_channel)
+        # Live re-step pricing for the policy's park break-even
+        # (train_loop cost hook).
+        trainer.step_cost_cb = self.policy.note_step
         if self.profiler is not None:
             self.profiler.mark_warmup()
+        t_restore0 = time.monotonic()
         state = self._restore_or_init(trainer)
+        self.policy.note_restore_cost(time.monotonic() - t_restore0)
         last_ckpt_step = int(state.step)
         t_start = time.perf_counter()
 
@@ -471,8 +507,10 @@ class MultiHostWorker:
             """Collective save (all ranks reach this in the same round), then
             rank 0 completes the shards that checkpoint now covers."""
             nonlocal last_ckpt_step
+            ck_t0 = time.monotonic()
             self.ckpt.save(int(state.step), state)
             self.ckpt.wait()
+            self.policy.note_checkpoint_cost(time.monotonic() - ck_t0)
             last_ckpt_step = int(state.step)
             if rank == 0:
                 for t in self._uncommitted:
@@ -500,8 +538,10 @@ class MultiHostWorker:
                 break
             if stop == "wait":
                 # Queue empty but leases outstanding (e.g. a previous
-                # incarnation's lease has not expired yet): idle this round.
-                time.sleep(0.2)
+                # incarnation's lease has not expired yet): idle this round,
+                # jittered so a whole gang's wait-round re-polls don't land
+                # on the coordinator in phase-locked waves.
+                time.sleep(self._jittered(0.2))
                 continue
             if msg.get("ckpt"):
                 checkpoint_and_commit()
@@ -622,7 +662,7 @@ class MultiHostWorker:
                 if self.client.heartbeat().get("ok"):
                     self.client.replay()
                 if len(self.client.outbox):
-                    time.sleep(0.2)
+                    time.sleep(self._jittered(0.2))
             if len(self.client.outbox):
                 log.warning(
                     "exiting with %d completions still buffered (coordinator "
@@ -634,6 +674,9 @@ class MultiHostWorker:
             else {}
         )
         outage = {f"outage_{k}": v for k, v in self.client.summary().items()}
+        outage.update({f"policy_{m}": float(n)
+                       for m, n in self.policy.decisions.items()})
+        outage["policy_incidents"] = float(self.policy.incidents)
         return {
             **prof,
             **outage,

@@ -171,6 +171,11 @@ class Trainer:
         #: must jit the identical decode program, so the codec is negotiated
         #: through the coordinator KV instead of inferred per-process.
         self.codec_channel = codec_channel
+        #: optional per-step cost feed, called with the measured wall
+        #: seconds of each completed step (device sync included). The
+        #: fault-tolerance policy (`runtime.ft_policy`) prices its re-step
+        #: cost from this; None keeps the hot loop unwrapped.
+        self.step_cost_cb: Optional[Callable[[float], None]] = None
 
         if cfg.grad_sync not in ("auto", "psum", "reduce_scatter"):
             raise ValueError(
@@ -583,7 +588,19 @@ class Trainer:
         batch paired with the codec generation that encoded it.
         """
         placed = self.place_batch(batch)
-        return placed, self._step_callable(placed)
+        fn = self._step_callable(placed)
+        cb = self.step_cost_cb
+        if cb is None:
+            return placed, fn
+
+        def timed(state: TrainState, b: Dict[str, Any]):
+            t0 = time.perf_counter()
+            out_state, loss = fn(state, b)
+            jax.block_until_ready(loss)
+            cb(time.perf_counter() - t0)
+            return out_state, loss
+
+        return placed, timed
 
     def train_step(self, state: TrainState, batch: Dict[str, Any]) -> Tuple[TrainState, jax.Array]:
         return self._step_callable(batch)(state, batch)

@@ -24,15 +24,18 @@ protocol, so it exercises exactly what a real middlebox failure would.
 
 from __future__ import annotations
 
+import json
 import logging
 import random
 import socket
 import threading
-from typing import Dict, List, Optional, Tuple
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger("edl_tpu.testing.chaosproxy")
 
-__all__ = ["ChaosProxy"]
+__all__ = ["ChaosProxy", "ScenarioStep", "ChaosScenario"]
 
 
 def _hard_close(sock: socket.socket) -> None:
@@ -224,8 +227,6 @@ class ChaosProxy:
 
     def _pump(self, pair: _ConnPair, src: socket.socket,
               dst: socket.socket, rng: random.Random) -> None:
-        import time
-
         try:
             while not self._stop.is_set():
                 try:
@@ -257,3 +258,194 @@ class ChaosProxy:
             with self._lock:
                 if pair in self._conns:
                     self._conns.remove(pair)
+
+
+# -- scripted scenarios --------------------------------------------------------
+
+
+@dataclass
+class ScenarioStep:
+    """One step of a scripted fault timeline.
+
+    ``action`` names a registered callable; ``when`` (optional) names a
+    registered predicate the step blocks on before firing — gating on
+    *workload state* ("job alpha finished 2 shards") rather than wall
+    clock is what makes a composed chaos run deterministic across
+    machines of different speeds. ``after`` adds a fixed delay once the
+    gate opens (e.g. "partition, hold 5 s, heal"). ``timeout`` bounds the
+    gate wait; an expired gate aborts the scenario (a chaos run whose
+    trigger never fired proves nothing, and must say so loudly).
+    """
+
+    action: str
+    when: str = ""
+    after: float = 0.0
+    timeout: float = 120.0
+    note: str = ""
+    kwargs: Dict = field(default_factory=dict)
+
+    def to_dict(self) -> Dict:
+        return {
+            "action": self.action, "when": self.when, "after": self.after,
+            "timeout": self.timeout, "note": self.note,
+            "kwargs": dict(self.kwargs),
+        }
+
+
+class ChaosScenario:
+    """Deterministic multi-axis fault conductor.
+
+    A composed chaos test (trainer SIGKILL × apiserver faults × network
+    partition) needs its faults *overlapping in a reproducible order* — ad
+    hoc ``sleep``-and-fire threads drift across machines and reorder under
+    load. The scenario runs an ordered step list on one driver thread:
+    each step optionally blocks on a named predicate (polled), waits a
+    fixed delay, then fires a named action. The fired timeline lands in
+    ``events`` (scheduled vs actual), and :meth:`spec` round-trips through
+    JSON so a failing run's exact fault schedule can be replayed.
+
+    Actions and predicates are registered by name::
+
+        sc = (ChaosScenario("composed")
+              .register_proxy("beta", proxy)           # beta.partition/.heal
+              .register("kill_alpha", proc.kill)
+              .predicate("alpha_warm", lambda: worker.steps_done >= 2)
+              .add("beta.partition", when="alpha_warm")
+              .add("beta.heal", after=1.5)
+              .add("kill_alpha"))
+        sc.start()
+        ...
+        sc.join()
+        assert sc.completed, sc.events
+    """
+
+    def __init__(self, name: str = "scenario"):
+        self.name = name
+        self.steps: List[ScenarioStep] = []
+        self._actions: Dict[str, Callable[..., object]] = {}
+        self._predicates: Dict[str, Callable[[], bool]] = {}
+        #: fired-event log: one dict per executed step, appended in order.
+        self.events: List[Dict] = []
+        self.completed = False
+        self.failed: Optional[str] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    # -- registry --------------------------------------------------------------
+
+    def register(self, name: str, fn: Callable[..., object]) -> "ChaosScenario":
+        self._actions[name] = fn
+        return self
+
+    def register_proxy(self, name: str, proxy: ChaosProxy) -> "ChaosScenario":
+        """Expose a proxy's fault controls as ``<name>.partition`` /
+        ``<name>.heal`` actions."""
+        self._actions[f"{name}.partition"] = proxy.partition
+        self._actions[f"{name}.heal"] = proxy.heal
+        return self
+
+    def predicate(self, name: str, fn: Callable[[], bool]) -> "ChaosScenario":
+        self._predicates[name] = fn
+        return self
+
+    def add(self, action: str, when: str = "", after: float = 0.0,
+            timeout: float = 120.0, note: str = "", **kwargs) -> "ChaosScenario":
+        self.steps.append(ScenarioStep(action=action, when=when, after=after,
+                                       timeout=timeout, note=note,
+                                       kwargs=kwargs))
+        return self
+
+    def spec(self) -> str:
+        """The schedule as JSON — committed into a failing test's output so
+        the exact fault timeline is replayable."""
+        return json.dumps(
+            {"name": self.name, "steps": [s.to_dict() for s in self.steps]},
+            indent=2)
+
+    @classmethod
+    def from_spec(cls, raw: str) -> "ChaosScenario":
+        data = json.loads(raw)
+        sc = cls(data.get("name", "scenario"))
+        for s in data.get("steps", []):
+            sc.steps.append(ScenarioStep(
+                action=s["action"], when=s.get("when", ""),
+                after=float(s.get("after", 0.0)),
+                timeout=float(s.get("timeout", 120.0)),
+                note=s.get("note", ""), kwargs=dict(s.get("kwargs", {}))))
+        return sc
+
+    # -- execution -------------------------------------------------------------
+
+    def start(self) -> "ChaosScenario":
+        missing = [s.action for s in self.steps if s.action not in self._actions]
+        missing += [s.when for s in self.steps
+                    if s.when and s.when not in self._predicates]
+        if missing:
+            raise ValueError(f"unregistered scenario names: {missing}")
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name=f"edl-scenario-{self.name}", daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def close(self) -> None:
+        self._stop.set()
+        self.join(timeout=5.0)
+
+    def __enter__(self) -> "ChaosScenario":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def _wait_for(self, step: ScenarioStep) -> bool:
+        pred = self._predicates[step.when]
+        deadline = time.monotonic() + step.timeout
+        while not self._stop.is_set():
+            try:
+                if pred():
+                    return True
+            except Exception:  # edl: noqa[EDL005] a predicate probing a worker being chaos-killed may transiently throw; that is "not yet", not a driver crash
+                pass
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.01)
+        return False
+
+    def _run(self) -> None:
+        for i, step in enumerate(self.steps):
+            if self._stop.is_set():
+                return
+            waited = 0.0
+            if step.when:
+                t_gate = time.monotonic()
+                if not self._wait_for(step):
+                    self.failed = (f"step {i} ({step.action}): gate "
+                                   f"{step.when!r} never opened")
+                    log.error("scenario %s aborted: %s", self.name, self.failed)
+                    return
+                waited = time.monotonic() - t_gate
+            if step.after > 0.0:
+                if self._stop.wait(step.after):
+                    return
+            try:
+                self._actions[step.action](**step.kwargs)
+            except Exception as e:  # edl: noqa[EDL005] the event log must record WHICH step blew up before the driver dies; tests assert completed/failed
+                self.failed = f"step {i} ({step.action}): {e!r}"
+                log.exception("scenario %s step %d (%s) failed",
+                              self.name, i, step.action)
+                return
+            self.events.append({
+                "step": i, "action": step.action, "when": step.when,
+                "note": step.note, "gate_wait": round(waited, 3),
+                "at": round(time.monotonic() - self._t0, 3),
+            })
+            log.info("scenario %s fired %s (step %d, t=%.2fs)",
+                     self.name, step.action, i,
+                     time.monotonic() - self._t0)
+        self.completed = True

@@ -1,0 +1,382 @@
+"""Composed cross-axis chaos: SIGKILL × apiserver faults × partitions, overlapping.
+
+The single-axis chaos suites each break one thing at a time
+(``test_coordinator_outage.py``: partitions; ``test_chaos.py``: pod kills;
+``test_k8s.py``: 409/410). Real incidents compose — a network partition
+storm arrives *while* a trainer is being replaced *while* the apiserver is
+rejecting status writes. This test runs all three axes overlapping under
+one :class:`ChaosScenario` (deterministic: every fault gates on observed
+workload state, never wall clock) and checks the combined invariants:
+
+- job alpha (trainer-SIGKILL axis) converges through its replacement pod;
+- job beta (partition axis) rides three blips, then checkpoint-and-parks
+  a sustained partition — the adaptive fault-tolerance policy must choose
+  at least two distinct recovery modes, visible in ``edl_ft_policy_*``
+  metrics scraped live from ``/metrics`` and in per-decision trace spans;
+- the K8s status updater and informer survive the 409s and mid-stream 410;
+- exactly-once holds on both queues, and beta's final loss matches an
+  unfaulted twin run (faults cost time, never training math).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from edl_tpu.coordinator import (
+    CoordinatorServer,
+    InProcessCoordinator,
+    RetryPolicy,
+)
+from edl_tpu.coordinator.client import CoordinatorClient
+from edl_tpu.obs.metrics import parse_prometheus
+from edl_tpu.runtime.ft_policy import PARK, RECONNECT, WAIT, FTPolicyConfig
+from edl_tpu.testing import ChaosProxy
+from edl_tpu.testing.chaosproxy import ChaosScenario
+
+from tests.test_coordinator import has_toolchain
+
+needs_native = pytest.mark.skipif(
+    not has_toolchain(), reason="native toolchain unavailable"
+)
+
+# Composed chaos is tier-2 (`make chaos-composed`); the interleavings are
+# prime sanitizer food, so the TSan lane picks it up too.
+pytestmark = [pytest.mark.chaos, pytest.mark.slow, pytest.mark.sanitizer]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_ALPHA = 6          # shards on the SIGKILL-axis job
+N_BETA = 12          # shards on the partition-axis job
+BETA_BATCHES = 4
+BETA_PACE = 0.2      # seconds/batch: keeps beta's queue alive through all
+                     # three blips + the storm (gates, not sleeps, do the
+                     # actual synchronization — this only sets the floor)
+
+
+def _scrape(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read().decode()
+
+
+@needs_native
+def test_composed_cross_axis_chaos(tmp_path):
+    import jax
+
+    from edl_tpu.api.types import JobPhase
+    from edl_tpu.k8s import ApiClient, K8sJobStore, KubeConfig
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime import SyntheticShardSource
+    from edl_tpu.runtime.data import shard_names
+    from edl_tpu.runtime.elastic import (
+        FT_POLICY_KEY,
+        ElasticConfig,
+        ElasticWorker,
+    )
+    from edl_tpu.runtime.train_loop import TrainerConfig
+    from tests.fake_apiserver import FakeApiServer
+    from tests.test_elastic import WORKER_CRASH_SRC
+    from tests.test_k8s import _client, _job
+
+    model = fit_a_line.MODEL
+
+    # -- axis 1: trainer SIGKILL (job alpha, subprocess workers) ---------------
+    server_a = CoordinatorServer(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    # -- axis 2: coordinator partition (job beta, in-thread, adaptive policy) --
+    server_b = CoordinatorServer(task_lease_sec=120.0, heartbeat_ttl_sec=120.0)
+    # -- axis 3: apiserver 409/410 (status updater + informer) -----------------
+    srv = FakeApiServer()
+    base = srv.serve()
+
+    alpha_procs = []
+
+    def spawn_alpha(name):
+        env = dict(os.environ)
+        env.update(PORT=str(server_a.port), NAME=name,
+                   CKPT=str(tmp_path / "ck-alpha"))
+        return subprocess.Popen(
+            [sys.executable, "-c", WORKER_CRASH_SRC.format(repo=REPO)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+
+    def kill_alpha():
+        p = alpha_procs[0]
+        p.kill()  # SIGKILL: no atexit, no finally, leases left dangling
+        p.wait()
+        # explicit leave in lieu of waiting out the heartbeat TTL: the dead
+        # worker's leases requeue so the replacement can drain them
+        server_a.client(alpha_names[0]).leave()
+
+    def respawn_alpha():
+        alpha_procs.append(spawn_alpha(alpha_names[1]))
+
+    alpha_names = ("w-a0", "w-a1")
+
+    counts = {}
+
+    class PacedCounting(SyntheticShardSource):
+        def read(self, shard):
+            counts[shard] = counts.get(shard, 0) + 1
+            for b in super().read(shard):
+                time.sleep(BETA_PACE)
+                yield b
+
+    watch_events = []
+
+    class Recorder:
+        def on_add(self, job):
+            watch_events.append(("add", job.name, job.status.phase))
+
+        def on_update(self, job):
+            watch_events.append(("update", job.name, job.status.phase))
+
+        def on_del(self, job):
+            watch_events.append(("del", job.name, job.status.phase))
+
+    stop_updater = threading.Event()
+    update_ok = [0]
+
+    store = K8sJobStore(_client(base), watch_timeout_seconds=5.0)
+    store.create(_job())
+
+    def updater():
+        # a controller's status writeback loop: keeps PATCHing /status
+        # through whatever the apiserver throws (armed 409s are absorbed
+        # by the store's conflict retry, invisibly to us)
+        while not stop_updater.is_set():
+            status = store.get("demo").status
+            status.phase = JobPhase.RUNNING
+            store.update_status("demo", status)
+            update_ok[0] += 1
+            stop_updater.wait(0.25)
+
+    try:
+        server_a.start()
+        server_b.start()
+        admin_a = server_a.client("admin")
+        admin_a.add_tasks(shard_names("ax", N_ALPHA))
+        admin_b = server_b.client("admin")
+        shards_b = shard_names("bx", N_BETA)
+        admin_b.add_tasks(shards_b)
+
+        store.watch(Recorder(), replay=True)
+        updater_t = threading.Thread(target=updater, daemon=True)
+        updater_t.start()
+
+        with ChaosProxy(server_b.port, seed=11) as proxy:
+            raw_b = CoordinatorClient(
+                port=proxy.port, worker="w-beta",
+                # fail fast so even a ~1 s blip registers as an incident
+                retry=RetryPolicy(deadline=0.5, seed=11))
+            source_b = PacedCounting(model, batch_size=8,
+                                     batches_per_shard=BETA_BATCHES)
+            cfg_b = ElasticConfig(
+                checkpoint_dir=str(tmp_path / "ck-beta"),
+                checkpoint_interval=4,
+                heartbeat_interval=0.0,  # poll the epoch every batch
+                metrics_port=0,          # ephemeral /metrics for the scrape
+                # budget 6 s: blips (~1.2 s) ride inside it during the
+                # cold-start static fallback; the storm blows through the
+                # adaptive threshold (quantile of the three closed blips)
+                ft_policy=FTPolicyConfig(
+                    outage_budget=6.0, min_history=3, min_wait=1.0,
+                    storm_retry_deadline=0.5),
+                trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+            )
+            worker_b = ElasticWorker(model, raw_b, source_b, cfg_b,
+                                     device_planner=lambda w: jax.devices())
+
+            beta_out = {}
+
+            def run_beta():
+                try:
+                    beta_out["metrics"] = worker_b.run()
+                except BaseException as e:  # edl: noqa[EDL005] re-raised via assert in the main thread
+                    beta_out["error"] = e
+
+            scraped = threading.Event()
+            policy = worker_b.policy
+
+            def hist(k):
+                return lambda: policy.state()["history"] >= k
+
+            def make_demo2():
+                job2 = _job()
+                job2.name = "demo2"
+                store.create(job2)
+
+            sc = (
+                ChaosScenario("composed")
+                .register_proxy("beta", proxy)
+                .register("alpha.kill", kill_alpha)
+                .register("alpha.respawn", respawn_alpha)
+                .register("api.conflicts",
+                          lambda n: setattr(srv, "status_conflicts", n))
+                .register("api.break_watch",
+                          lambda: setattr(srv, "watch_error_410_after", 1))
+                .register("api.create_demo2", make_demo2)
+                .predicate("alpha_progress",
+                           lambda: int(admin_a.status().get("done", 0)) >= 2)
+                .predicate("beta_warm", lambda: worker_b.steps_done >= 2)
+                .predicate("hist1", hist(1))
+                .predicate("hist2", hist(2))
+                .predicate("hist3", hist(3))
+                .predicate("scraped", scraped.is_set)
+                # every fault gates on workload state: reproducible on any
+                # machine speed. The axes overlap by construction — alpha's
+                # replacement drains and the 409s are live while beta's
+                # partitions land.
+                .add("api.conflicts", n=2, note="arm /status 409s")
+                .add("alpha.kill", when="alpha_progress",
+                     note="SIGKILL the trainer mid-queue")
+                .add("alpha.respawn", after=0.2,
+                     note="Job-controller reconcile: replacement pod")
+                .add("beta.partition", when="beta_warm", note="blip 1")
+                .add("beta.heal", after=1.2)
+                .add("beta.partition", when="hist1", note="blip 2")
+                .add("beta.heal", after=1.2)
+                .add("api.break_watch", note="410 mid-stream: etcd compaction")
+                .add("beta.partition", when="hist2", note="blip 3")
+                .add("beta.heal", after=1.2)
+                .add("api.create_demo2",
+                     note="the relisted informer must deliver this")
+                .add("beta.partition", when="hist3",
+                     note="the storm: held until beta parks")
+                .add("beta.heal", when="scraped", timeout=180.0,
+                     note="heal only after checkpoint-and-park + live scrape")
+            )
+
+            alpha_procs.append(spawn_alpha(alpha_names[0]))
+            beta_t = threading.Thread(target=run_beta, daemon=True)
+            beta_t.start()
+            sc.start()
+
+            # main thread: wait for the park decision, then scrape the live
+            # worker while it is parked (its /metrics thread keeps serving
+            # through the partition — that's the point of the probe).
+            deadline = time.time() + 300
+            while time.time() < deadline:
+                if policy.decisions[PARK] >= 1:
+                    break
+                assert sc.failed is None, (sc.failed, sc.spec())
+                assert "error" not in beta_out, beta_out
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"beta never parked: {policy.state()} "
+                            f"scenario={sc.events}")
+
+            url = getattr(worker_b, "metrics_url", None)
+            assert url, "metrics server never came up"
+            families = parse_prometheus(_scrape(url + "/metrics"))
+            fam_names = {n for n in families if n.startswith("edl_ft_policy_")}
+            assert {"edl_ft_policy_decisions_total", "edl_ft_policy_mode",
+                    "edl_ft_policy_incidents_total",
+                    "edl_ft_policy_park_threshold_seconds",
+                    }.issubset(fam_names), fam_names
+            health = json.loads(_scrape(url + "/healthz"))
+            assert health["ft_policy"]["mode"] == PARK, health["ft_policy"]
+            scraped.set()
+
+            sc.join(timeout=180)
+            assert sc.completed, (sc.failed, sc.events, sc.spec())
+
+            beta_t.join(timeout=300)
+            assert not beta_t.is_alive(), "beta never drained after heal"
+            assert "error" not in beta_out, beta_out["error"]
+            metrics_b = beta_out["metrics"]
+
+        st_b = admin_b.status()
+        # the policy's KV audit record survived the chaos (buffered through
+        # the outbox during the very outage it describes)
+        audit_raw = admin_b.kv_get(FT_POLICY_KEY.format(worker="w-beta"))
+        admin_b.close()
+
+        # alpha's replacement converges
+        out, err = alpha_procs[1].communicate(timeout=240)
+        assert alpha_procs[1].returncode == 0, (
+            f"alpha replacement failed:\n{err[-3000:]}")
+        st_a = admin_a.status()
+        admin_a.close()
+    finally:
+        stop_updater.set()
+        store.stop()
+        for p in alpha_procs:
+            if p.poll() is None:
+                p.kill()
+        server_a.stop()
+        server_b.stop()
+        srv.close()
+
+    # -- axis 1: exactly-once through the kill ---------------------------------
+    assert int(st_a["done"]) == N_ALPHA, st_a
+    assert int(st_a["queued"]) == 0 and int(st_a["leased"]) == 0, st_a
+
+    # -- axis 2: the adaptive policy adjudicated every incident ----------------
+    # >= 2 distinct recovery modes actually chosen (blips reconnect in
+    # place, the storm parks); >= 4 incidents (3 blips + storm)
+    used = [m for m, n in worker_b.policy.decisions.items() if n > 0]
+    assert len(used) >= 2, worker_b.policy.decisions
+    assert worker_b.policy.decisions[RECONNECT] >= 3, worker_b.policy.decisions
+    assert worker_b.policy.decisions[PARK] >= 1, worker_b.policy.decisions
+    assert worker_b.policy.incidents >= 4
+    assert metrics_b["policy_park"] >= 1.0, metrics_b
+    # every decision left a span carrying the inputs it was computed from
+    spans = worker_b.tracer.find(name="ft_decision")
+    assert len(spans) >= worker_b.policy.incidents
+    for s in spans:
+        for key in ("mode", "threshold", "elapsed", "park_breakeven",
+                    "failure_rate_per_min"):
+            assert key in s.attrs, s.attrs
+    assert {s.attrs["mode"] for s in spans} >= {WAIT, RECONNECT, PARK}
+    audit = json.loads(audit_raw)
+    assert audit["policy"] == "adaptive" and audit["incidents"] >= 4, audit
+
+    # exactly-once on beta: ledger balanced; every shard completed once.
+    # Reads: blips never force a re-read (leases ride them out), and the
+    # park may re-open only the single shard in flight when it fired — the
+    # carry skips its consumed batches, so the re-read retrains nothing
+    # (proven below: step count and loss match the unfaulted twin).
+    assert int(st_b["done"]) == N_BETA, st_b
+    assert int(st_b["queued"]) == 0 and int(st_b["leased"]) == 0, st_b
+    assert set(counts) == set(shards_b), counts
+    replayed = [s for s, n in counts.items() if n > 1]
+    assert len(replayed) <= 1 and all(counts[s] == 2 for s in replayed), counts
+
+    # -- axis 3: the apiserver faults were absorbed, not crashed through ------
+    assert update_ok[0] >= 3, "status updater made no progress"
+    assert srv.status_conflicts == 0, "armed 409s never exercised"
+    assert any(e[0] == "add" and e[1] == "demo2" for e in watch_events), (
+        "informer never resumed after the mid-stream 410", watch_events)
+    assert any(e[0] == "update" and e[2] == JobPhase.RUNNING
+               for e in watch_events), watch_events
+
+    # -- loss parity: chaos cost time, not training math -----------------------
+    coord = InProcessCoordinator(task_lease_sec=120.0, heartbeat_ttl_sec=120.0)
+    twin_admin = coord.client("admin")
+    twin_admin.register()
+    twin_admin.add_tasks(shards_b)
+    twin_cfg = ElasticConfig(
+        checkpoint_dir=str(tmp_path / "ck-twin"),
+        checkpoint_interval=4,
+        heartbeat_interval=0.0,
+        trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+    )
+    twin = ElasticWorker(
+        model, coord.client("w-twin"),
+        SyntheticShardSource(model, batch_size=8,
+                             batches_per_shard=BETA_BATCHES),
+        twin_cfg, device_planner=lambda w: jax.devices())
+    metrics_twin = twin.run()
+    # at-least-once on the park path: the shard in flight when the park
+    # fired may replay its uncovered batches — never more than one shard's
+    # worth, never fewer steps than the clean run
+    extra = metrics_b["steps"] - metrics_twin["steps"]
+    assert 0 <= extra <= BETA_BATCHES, (metrics_b, metrics_twin)
+    assert metrics_b["final_loss"] == pytest.approx(
+        metrics_twin["final_loss"], rel=0.05), (metrics_b, metrics_twin)

@@ -1,0 +1,471 @@
+"""Fault-tolerance policy engine: transitions, hysteresis, cost model.
+
+Everything here runs in injected fake time — the policy's clock is a
+parameter precisely so these decisions are testable without sleeping.
+The composed cross-axis chaos e2e that exercises the policy against real
+sockets and real SIGKILLs lives in ``tests/test_chaos_composed.py``.
+"""
+
+import itertools
+
+import pytest
+
+from edl_tpu.obs.instruments import FTPolicyInstruments
+from edl_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+from edl_tpu.obs.tracing import Tracer
+from edl_tpu.runtime.ft_policy import (
+    PARK,
+    RECONNECT,
+    WAIT,
+    WARM_RESTART,
+    FTPolicy,
+    FTPolicyConfig,
+)
+
+pytestmark = [pytest.mark.chaos]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_policy(cfg=None, **kwargs):
+    """Policy with isolated instruments/tracer so tests don't share the
+    process registry's counters."""
+    clock = kwargs.pop("clock", None) or FakeClock()
+    reg = MetricsRegistry()
+    tracer = Tracer(component="test")
+    p = FTPolicy(cfg if cfg is not None else FTPolicyConfig(),
+                 worker="wtest", instruments=FTPolicyInstruments(reg),
+                 tracer=tracer, clock=clock)
+    return p, clock, reg, tracer
+
+
+# -- static escape hatch -------------------------------------------------------
+
+
+def test_static_policy_reproduces_outage_budget():
+    """policy="static" must behave exactly like the old fixed threshold,
+    history or not."""
+    cfg = FTPolicyConfig(policy="static", outage_budget=10.0, min_history=1)
+    p, clock, _, _ = make_policy(cfg)
+    # saturate history with long outages — static must not care
+    for _ in range(8):
+        p.on_outage(0.1)
+        p.note_outage_closed(300.0)
+        clock.advance(1.0)
+    assert p.threshold() == 10.0
+    assert p.on_outage(9.9) == WAIT
+    assert p.on_outage(10.1) == PARK
+
+
+def test_adaptive_cold_start_defers_to_static_budget():
+    """Below min_history the adaptive rule is inert: a fleet upgrade changes
+    nothing until evidence accumulates (this is what keeps the existing
+    single-partition chaos tests byte-identical in behavior)."""
+    p, _, _, _ = make_policy(FTPolicyConfig(outage_budget=60.0, min_history=3))
+    p.note_outage_closed(0.5)
+    p.note_outage_closed(0.4)
+    assert p.threshold() == 60.0
+    assert p.on_outage(59.0) == WAIT
+
+
+# -- mode transitions ----------------------------------------------------------
+
+
+def test_blip_history_waits_then_reconnects_in_place():
+    """blip → in-place: short-outage history keeps the threshold above a
+    fresh blip, so the worker rides it out and the close records the
+    reconnect decision."""
+    p, clock, _, _ = make_policy(FTPolicyConfig(min_history=3, min_wait=1.0))
+    for _ in range(3):
+        p.on_outage(0.2)
+        p.note_outage_closed(0.5)
+        clock.advance(60.0)  # spaced out: not a storm
+    # threshold now adaptive: max(0.5 * 1.5, breakeven=0) clamped to min_wait
+    assert p.threshold() == 1.0
+    assert p.on_outage(0.6) == WAIT
+    p.note_outage_closed(0.7)
+    assert p.last_mode == RECONNECT
+    assert p.decisions[PARK] == 0
+
+
+def test_storm_outage_escalates_to_park_long_before_static_budget():
+    """storm → park: once history shows outages are short, an outage that
+    blows past the distribution escalates at the computed threshold, not
+    at the static 60 s."""
+    p, clock, _, _ = make_policy(
+        FTPolicyConfig(outage_budget=60.0, min_history=3, min_wait=1.0))
+    for _ in range(3):
+        p.on_outage(0.2)
+        p.note_outage_closed(0.5)
+        clock.advance(60.0)
+    t = p.threshold()
+    assert t < 5.0  # the adaptive win: escalate in seconds, not a minute
+    assert p.on_outage(t + 0.1) == PARK
+    assert p.decisions[PARK] == 1
+
+
+def test_multihost_escalation_terminal_is_warm_restart():
+    p, _, _, _ = make_policy(FTPolicyConfig(policy="static", outage_budget=1.0))
+    assert p.on_outage(0.5, escalate_mode=WARM_RESTART) == WAIT
+    assert p.on_outage(1.5, escalate_mode=WARM_RESTART) == WARM_RESTART
+
+
+# -- hysteresis ----------------------------------------------------------------
+
+
+def test_hysteresis_flapping_input_cannot_flap_the_mode():
+    """Oscillating elapsed readings (clock weirdness, interleaved pollers)
+    after escalation keep reporting the terminal mode: the latch is
+    monotone within an incident, so wait→park→wait→park is impossible."""
+    p, _, _, _ = make_policy(FTPolicyConfig(policy="static", outage_budget=2.0))
+    assert p.on_outage(1.0) == WAIT
+    assert p.on_outage(2.5) == PARK
+    for elapsed in (0.1, 3.0, 0.0, 2.1, 1.0):
+        assert p.on_outage(elapsed) == PARK
+    # exactly one park decision for the whole incident
+    assert p.decisions[PARK] == 1
+
+
+def test_hysteresis_threshold_frozen_at_incident_open():
+    """Evidence arriving mid-incident cannot move the goalposts: the
+    threshold the comparison uses is the one frozen when the incident
+    opened, so the wait→escalate flip happens at most once and at a
+    predictable point."""
+    p, clock, _, _ = make_policy(FTPolicyConfig(min_history=3, min_wait=1.0))
+    for _ in range(3):
+        p.on_outage(0.1)
+        p.note_outage_closed(0.5)
+        clock.advance(60.0)
+    frozen = p.threshold()
+    assert p.on_outage(0.2) == WAIT  # incident opens; threshold freezes
+    # a huge checkpoint cost would raise the NEXT incident's threshold...
+    p.note_checkpoint_cost(50.0)
+    p.note_restore_cost(50.0)
+    assert p.threshold() > frozen
+    # ...but not this one's: it escalates at the frozen value.
+    assert p.on_outage(frozen + 0.1) == PARK
+
+
+def test_incident_close_resets_the_ladder():
+    p, _, _, _ = make_policy(FTPolicyConfig(policy="static", outage_budget=1.0))
+    p.on_outage(0.5)
+    assert p.on_outage(1.5) == PARK
+    p.note_outage_closed(2.0)
+    assert not p.incident_open
+    # fresh incident starts back at WAIT with a fresh frozen threshold
+    assert p.on_outage(0.5) == WAIT
+
+
+# -- cost model ----------------------------------------------------------------
+
+
+def test_park_breakeven_raises_threshold_when_parking_is_expensive():
+    """Waiting must stay preferred while it is cheaper than the park
+    round-trip: expensive checkpoints + lots of uncheckpointed steps push
+    the threshold up."""
+    cfg = FTPolicyConfig(min_history=1, min_wait=0.1, park_cost_factor=2.0)
+    p, _, _, _ = make_policy(cfg)
+    p.note_outage_closed(0.1)  # activate the adaptive rule
+    cheap = p.threshold()
+    p.note_checkpoint_cost(3.0)
+    p.note_restore_cost(2.0)
+    for _ in range(10):
+        p.note_step(0.5)  # 10 uncheckpointed steps x 0.5 s
+    assert p.restep_cost() == pytest.approx(5.0)
+    assert p.park_breakeven() == pytest.approx(2.0 * (3.0 + 2.0 + 5.0))
+    assert p.threshold() > cheap
+    # a fresh durable checkpoint zeroes the re-step exposure
+    p.note_checkpoint_cost(3.0)
+    assert p.restep_cost() == 0.0
+
+
+def test_threshold_is_capped_by_the_static_budget():
+    """Adaptive may escalate sooner than the old budget, never later."""
+    cfg = FTPolicyConfig(outage_budget=10.0, min_history=1)
+    p, _, _, _ = make_policy(cfg)
+    p.note_outage_closed(500.0)  # history says outages are enormous
+    p.note_checkpoint_cost(500.0)
+    assert p.threshold() == 10.0
+
+
+def test_storm_detector_shortens_retry_deadline():
+    cfg = FTPolicyConfig(min_history=3, storm_rate_per_min=6.0,
+                         storm_retry_deadline=5.0)
+    p, clock, _, _ = make_policy(cfg)
+    assert p.retry_deadline() is None
+    for _ in range(6):  # 6 incidents in ~5 fake seconds: a storm
+        p.note_outage_closed(0.3)
+        clock.advance(1.0)
+    assert p.in_storm()
+    assert p.retry_deadline() == 5.0
+    # calm regime: same incident count spread over fake hours
+    q, qclock, _, _ = make_policy(cfg)
+    for _ in range(6):
+        q.note_outage_closed(0.3)
+        qclock.advance(600.0)
+    assert not q.in_storm()
+    assert q.retry_deadline() is None
+
+
+# -- observability -------------------------------------------------------------
+
+
+def test_decisions_surface_as_metrics_and_spans():
+    p, _, reg, tracer = make_policy(
+        FTPolicyConfig(policy="static", outage_budget=1.0))
+    p.on_outage(0.5)
+    p.on_outage(1.5)
+    p.note_outage_closed(2.0)
+    p.on_outage(0.2)
+    p.note_outage_closed(0.3)
+    families = parse_prometheus(reg.render_prometheus())
+    incidents = families["edl_ft_policy_incidents_total"]["samples"]
+    assert incidents["edl_ft_policy_incidents_total"] == 2.0
+    decisions = families["edl_ft_policy_decisions_total"]["samples"]
+    assert decisions['edl_ft_policy_decisions_total{mode="wait"}'] == 2.0
+    assert decisions['edl_ft_policy_decisions_total{mode="park"}'] == 1.0
+    assert decisions['edl_ft_policy_decisions_total{mode="reconnect"}'] == 1.0
+    assert "edl_ft_policy_park_threshold_seconds" in families
+    events = tracer.find(name="ft_decision")
+    assert len(events) == 4
+    # every decision span carries its inputs — the audit trail
+    for ev in events:
+        for key in ("mode", "threshold", "elapsed", "park_breakeven",
+                    "failure_rate_per_min"):
+            assert key in ev.attrs, ev.attrs
+    assert {e.attrs["mode"] for e in events} == {WAIT, PARK, RECONNECT}
+
+
+def test_state_dict_is_json_ready():
+    import json
+
+    p, _, _, _ = make_policy()
+    p.on_outage(0.5)
+    p.note_outage_closed(1.0)
+    st = json.loads(json.dumps(p.state()))
+    assert st["policy"] == "adaptive"
+    assert st["mode"] == RECONNECT
+    assert st["incidents"] == 1
+
+
+# -- the mutant check ----------------------------------------------------------
+
+
+def _run_trace(policy, trace, clock, park_overhead=2.0, wait_drag=0.1):
+    """Replay a failure trace through a policy and price its choices.
+
+    Cost model (explained, not tuned): waiting through an outage costs
+    ``wait_drag`` per second (leased batches keep stepping, so degraded
+    time is cheap but not free); escalating costs the time spent deciding
+    plus ``park_overhead`` (checkpoint + restore + replayed steps).
+    """
+    cost = 0.0
+    for duration, gap in trace:
+        t = 0.0
+        escalated = False
+        while t < duration:
+            t = min(duration, t + 0.1)
+            if policy.on_outage(t) == PARK:
+                escalated = True
+                break
+        if escalated:
+            cost += t * wait_drag + park_overhead
+        else:
+            cost += duration * wait_drag
+        policy.note_outage_closed(duration)
+        clock.advance(gap)
+    return cost
+
+
+#: 8 blips then 3 storms — the regime change the adaptive rule exists for.
+TRACE = [(0.4, 60.0)] * 8 + [(120.0, 60.0)] * 3
+
+
+def test_mutant_forced_modes_measurably_underperform_adaptive():
+    """A policy pinned to either pure strategy must cost measurably more
+    than the adaptive one on a blips-then-storms trace: always-wait burns
+    the full outage on every storm, always-park pays the park round-trip
+    on every blip. If this assertion ever fails, the policy layer has
+    stopped earning its complexity."""
+    # budget 10 s: the operator's hard cap on degraded time. It also caps
+    # history contamination — after the first 120 s storm lands in the
+    # window the quantile explodes, and the clamp is what keeps storms
+    # 2..3 escalating promptly instead of inheriting storm-sized patience.
+    adaptive, clock_a, _, _ = make_policy(
+        FTPolicyConfig(outage_budget=10.0, min_history=3, min_wait=1.0))
+    cost_adaptive = _run_trace(adaptive, TRACE, clock_a)
+
+    forced_wait, clock_w, _, _ = make_policy(
+        FTPolicyConfig(policy="static", outage_budget=1000.0))
+    cost_wait = _run_trace(forced_wait, TRACE, clock_w)
+
+    forced_park, clock_p, _, _ = make_policy(
+        FTPolicyConfig(policy="static", outage_budget=0.2))
+    cost_park = _run_trace(forced_park, TRACE, clock_p)
+
+    # adaptive waited through the blips and parked the storms
+    assert adaptive.decisions[PARK] == 3
+    assert adaptive.decisions[RECONNECT] == 8
+    assert cost_adaptive < 0.7 * cost_wait, (cost_adaptive, cost_wait)
+    assert cost_adaptive < 0.7 * cost_park, (cost_adaptive, cost_park)
+
+
+# -- config validation (satellite: fail at construction) -----------------------
+
+
+def test_elastic_config_rejects_bad_fault_tolerance_knobs(tmp_path):
+    from edl_tpu.runtime.elastic import ElasticConfig
+
+    ck = str(tmp_path / "ck")
+    with pytest.raises(ValueError, match="outage_budget"):
+        ElasticConfig(checkpoint_dir=ck, outage_budget=-5.0)
+    with pytest.raises(ValueError, match="heartbeat_interval"):
+        ElasticConfig(checkpoint_dir=ck, heartbeat_interval=-1.0)
+    with pytest.raises(ValueError, match="heartbeat_jitter"):
+        ElasticConfig(checkpoint_dir=ck, heartbeat_jitter=1.5)
+    with pytest.raises(ValueError, match="checkpoint_interval"):
+        ElasticConfig(checkpoint_dir=ck, checkpoint_interval=0)
+    with pytest.raises(ValueError, match="pipeline_depth"):
+        ElasticConfig(checkpoint_dir=ck, pipeline_depth=-1)
+    with pytest.raises(ValueError, match="rescale_barrier_timeout"):
+        ElasticConfig(checkpoint_dir=ck, rescale_barrier_timeout=0.0)
+    with pytest.raises(ValueError, match="policy"):
+        ElasticConfig(checkpoint_dir=ck, policy="yolo")
+    # the boundary cases tests and production both rely on stay legal
+    ElasticConfig(checkpoint_dir=ck, heartbeat_interval=0.0)
+    ElasticConfig(checkpoint_dir=ck, heartbeat_jitter=0.0)
+    ElasticConfig(checkpoint_dir=ck, policy="static")
+
+
+def test_ft_policy_config_validation():
+    with pytest.raises(ValueError, match="policy"):
+        FTPolicyConfig(policy="aggressive")
+    with pytest.raises(ValueError, match="outage_budget"):
+        FTPolicyConfig(outage_budget=0.0)
+    with pytest.raises(ValueError, match="min_history"):
+        FTPolicyConfig(min_history=0)
+    with pytest.raises(ValueError, match="residual_quantile"):
+        FTPolicyConfig(residual_quantile=1.5)
+
+
+# -- outbox incident callback (the policy's sensor feed) -----------------------
+
+
+class _FlakyClient:
+    """Raises CoordinatorError until told otherwise."""
+
+    worker = "wflaky"
+
+    def __init__(self):
+        self.up = True
+
+    def call(self, op, **fields):
+        from edl_tpu.coordinator.client import CoordinatorUnreachable
+
+        if not self.up:
+            raise CoordinatorUnreachable("down")
+        return {"ok": True, "op": op}
+
+    def heartbeat(self):
+        return self.call("heartbeat")
+
+    def register(self, takeover=False):
+        return self.call("register")
+
+    def acquire(self):
+        return self.call("acquire")
+
+    def close(self):
+        pass
+
+
+def test_outbox_reports_per_incident_durations():
+    """The on_outage_close hook fires once per incident with its duration —
+    the per-incident signal the running-total gauge aggregates away."""
+    from edl_tpu.coordinator.outbox import OutboxClient
+
+    raw = _FlakyClient()
+    client = OutboxClient(raw)
+    closed = []
+    client.on_outage_close = closed.append
+
+    raw.up = False
+    client.heartbeat()
+    client.heartbeat()
+    assert closed == []  # still down: incident open, nothing closed
+    raw.up = True
+    client.heartbeat()
+    assert len(closed) == 1 and closed[0] >= 0.0
+    raw.up = False
+    client.complete_task("s1")  # buffered mutation opens incident #2
+    raw.up = True
+    client.heartbeat()
+    assert len(closed) == 2
+    assert client.outages == 2
+
+
+# -- scripted scenarios (the composed-chaos conductor) -------------------------
+
+
+def test_scenario_fires_steps_in_order_with_gates():
+    from edl_tpu.testing.chaosproxy import ChaosScenario
+
+    fired = []
+    gate = {"open": False}
+    sc = (ChaosScenario("unit")
+          .register("a", lambda: fired.append("a"))
+          .register("b", lambda tag: fired.append(f"b:{tag}"))
+          .predicate("gate", lambda: gate["open"])
+          .add("a")
+          .add("b", when="gate", tag="x")
+          .add("a", after=0.05))
+    sc.start()
+    import time as _time
+
+    _time.sleep(0.1)
+    assert fired == ["a"]  # step 2 is gated
+    gate["open"] = True
+    sc.join(timeout=5.0)
+    assert sc.completed and sc.failed is None
+    assert fired == ["a", "b:x", "a"]
+    assert [e["action"] for e in sc.events] == ["a", "b", "a"]
+
+
+def test_scenario_gate_timeout_fails_loudly():
+    from edl_tpu.testing.chaosproxy import ChaosScenario
+
+    sc = (ChaosScenario("stuck")
+          .register("never", lambda: None)
+          .predicate("no", lambda: False)
+          .add("never", when="no", timeout=0.1))
+    sc.start()
+    sc.join(timeout=5.0)
+    assert not sc.completed
+    assert "never opened" in sc.failed
+
+
+def test_scenario_spec_round_trips_through_json():
+    from edl_tpu.testing.chaosproxy import ChaosScenario
+
+    sc = (ChaosScenario("rt")
+          .add("x.partition", when="warm", after=1.5, note="sever")
+          .add("x.heal", after=2.0))
+    clone = ChaosScenario.from_spec(sc.spec())
+    assert [s.to_dict() for s in clone.steps] == [s.to_dict() for s in sc.steps]
+
+
+def test_scenario_rejects_unregistered_names():
+    from edl_tpu.testing.chaosproxy import ChaosScenario
+
+    sc = ChaosScenario("bad").add("ghost")
+    with pytest.raises(ValueError, match="ghost"):
+        sc.start()

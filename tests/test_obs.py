@@ -519,3 +519,30 @@ def test_cli_status_unreachable_coordinator(capsys):
     rc = main(["status", "--port", "1", "--timeout", "0.5"])
     assert rc == 1
     assert "ERROR" in capsys.readouterr().err
+
+
+def test_cli_status_renders_ft_policy_section(capsys):
+    """Workers publish their live policy state to coordinator KV
+    (edl/ft_policy/<worker>); `edl-tpu status` reads it back per member."""
+    from edl_tpu.cli import main
+    from edl_tpu.coordinator import CoordinatorServer
+
+    with CoordinatorServer() as server:
+        w = server.client("trainer-0")
+        w.register()
+        w.kv_put("edl/ft_policy/trainer-0", json.dumps({
+            "policy": "adaptive", "mode": "park", "threshold": 4.2,
+            "incidents": 3, "storm": False,
+        }))
+
+        rc = main(["status", "--port", str(server.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "fault-tolerance policy:" in out
+        assert "policy=adaptive" in out and "mode=park" in out
+
+        rc = main(["status", "--port", str(server.port), "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["ft_policy"]["trainer-0"]["mode"] == "park"
+        assert payload["ft_policy"]["trainer-0"]["threshold"] == 4.2
