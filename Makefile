@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-ANALYZE_SCOPE = edl_tpu bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py
+ANALYZE_SCOPE = edl_tpu edl_tpu/serving bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective
+.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -42,6 +42,14 @@ chaos-composed:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.obs
 
+## Serving-path deploy gate: exports a real artifact, boots a ServingReplica
+## with its HTTP frontend, pushes requests through POST /predict, swaps a
+## model version mid-traffic, then scrapes /metrics and asserts the latency
+## + queue-depth families (the autoscaler's signals), zero dropped requests,
+## and the empty-jit-dispatch-cache AOT contract. See doc/serving.md.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.serving
+
 ## Protocol behavior gate: bounded explicit-state exploration of every
 ## interleaving of the default faulty 2-worker schedule (crash+restart,
 ## duplicate delivery, batch frame), each trace replayed against
@@ -67,9 +75,9 @@ tsan-smoke:
 
 ## Everything a PR must pass: static analysis (EDL001-EDL009 vs baseline +
 ## protocol_schema.json ratchet), tier-1 tests, protocol model check,
-## TSan lane. Tier-2 (slow, run before cutting a release): `make chaos`
-## and `make chaos-composed` — the soaks and the composed cross-axis run.
-verify: analyze test modelcheck tsan-smoke
+## serving smoke, TSan lane. Tier-2 (slow, run before cutting a release):
+## `make chaos` and `make chaos-composed` — soaks + composed cross-axis run.
+verify: analyze test modelcheck serve-smoke tsan-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).
@@ -86,5 +94,11 @@ bench-coord:
 ## regenerates BENCH_COLLECTIVE.json (doc/performance.md, data-plane section).
 bench-collective:
 	$(PYTHON) bench_collective.py
+
+## Serving-tier arms: open-loop load vs batching-on/off, per-bucket-config
+## p50/p99 + QPS/chip, and rescale-under-traffic (replica added + drained
+## mid-load, zero dropped requests); regenerates BENCH_SERVE.json.
+bench-serve:
+	$(PYTHON) bench_serve.py
 
 lint: analyze

@@ -1,0 +1,306 @@
+"""Serving-tier bench: continuous batching vs none, bucket configs, and
+rescale-under-traffic — regenerates BENCH_SERVE.json.
+
+Each batching arm runs two phases against one replica:
+
+- **open-loop latency** — requests arrive on a Poisson schedule at a rate
+  below single-replica capacity and do NOT slow down when the server lags
+  (closed-loop generators hide overload by self-throttling); p50/p99 are
+  honest service latencies, not backlog artifacts.
+- **burst throughput** — all requests submitted at once; wall-clock to
+  drain the queue gives saturated QPS (and QPS/chip). This is where
+  continuous batching pays: the same request count collapses into ~N/32
+  device dispatches instead of N.
+
+Arms:
+
+- ``batching_on``  — the full bucket ladder + coalesce window.
+- ``batching_off`` — bucket ladder (1,), zero coalesce delay: every request
+  is its own batch (the naive frontend this package replaces).
+- one ``batching_on`` run per bucket configuration (the bucket table).
+- ``rescale_under_traffic`` — a 2-replica pool behind a round-robin router;
+  mid-load a third replica joins (AOT-compiles, then takes traffic) and
+  one replica drains out. Every accepted request must resolve: the
+  zero-dropped-requests number IS the result.
+
+CPU-sim caveat (same discipline as the sibling benches): numbers are
+generated on the CPU backend with virtual devices, so absolute latency is
+meaningless next to a real TPU pod — the comparisons (batching on/off,
+bucket shapes, drop counts under rescale) are the portable part.
+QPS/chip divides by `jax.device_count()` per the MLPerf-style per-chip
+accounting the TPU-pod papers report.
+"""
+
+from __future__ import annotations
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import json
+import tempfile
+import threading
+import time
+from typing import Dict, List
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), "BENCH_SERVE.json")
+
+RATE_QPS = 120.0  # below single-replica CPU-sim capacity (~300 QPS)
+N_REQUESTS = 360
+BURST_REQUESTS = 512
+BUCKET_CONFIGS = ((1, 8, 32), (1, 4, 16), (8, 32))
+
+
+def _export_artifact(directory: str, scale: float = 1.0, step: int = 100):
+    import jax
+
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.runtime.export import _serving_mesh, save_inference_model
+
+    model = fit_a_line.MODEL
+    mesh = _serving_mesh(model)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    if scale != 1.0:
+        params = jax.tree_util.tree_map(lambda x: x * scale, params)
+    save_inference_model(directory, "fit_a_line", params, step=step,
+                         versioned=True)
+
+
+def _requests(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    features = [{"x": rng.standard_normal(13).astype(np.float32)}
+                for _ in range(n)]
+    # exponential inter-arrivals -> Poisson arrivals at RATE_QPS
+    gaps = rng.exponential(1.0 / RATE_QPS, size=n)
+    return features, np.cumsum(gaps)
+
+
+def _percentiles(latencies: List[float]) -> Dict[str, float]:
+    arr = np.asarray(latencies)
+    return {
+        "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+        "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+        "mean_ms": round(float(arr.mean()) * 1e3, 3),
+    }
+
+
+def _open_loop(submit, n: int, seed: int = 0):
+    """Fire ``n`` requests on the open-loop schedule; returns
+    ([(future, record)], submit_errors). Completion time is stamped by a
+    done-callback AT resolution — measuring at collection time would
+    charge early requests for the whole submission window."""
+    features, arrivals = _requests(n, seed)
+    t0 = time.monotonic()
+    futures, errors = [], 0
+    for feat, due in zip(features, arrivals):
+        delay = t0 + due - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            t_submit = time.monotonic()
+            fut = submit(feat)
+            record = {"t_submit": t_submit, "t_done": None}
+            fut.add_done_callback(
+                lambda f, r=record: r.__setitem__("t_done", time.monotonic())
+            )
+            futures.append((fut, record))
+        except Exception:  # edl: noqa[EDL005] overload rejections are a measured outcome of the open-loop arm, reported as submit errors in the results
+            errors += 1
+    return futures, errors
+
+
+def run_arm(name: str, buckets, max_delay_s: float) -> Dict:
+    import jax
+
+    from edl_tpu.serving import ServingConfig, ServingReplica
+
+    with tempfile.TemporaryDirectory() as td:
+        _export_artifact(td)
+        replica = ServingReplica(ServingConfig(
+            model_dir=td, buckets=buckets, max_batch_delay_s=max_delay_s,
+            queue_capacity=4096, name=f"bench-{name}",
+        )).start()
+        try:
+            # phase 1: open-loop latency below capacity
+            futures, submit_errors = _open_loop(replica.submit, N_REQUESTS)
+            latencies = []
+            failed = 0
+            for fut, record in futures:
+                try:
+                    fut.result(timeout=60)
+                    latencies.append(record["t_done"] - record["t_submit"])
+                except Exception:  # edl: noqa[EDL005] per-request failures are a measured outcome, reported as the arm's failed count
+                    failed += 1
+            # phase 2: burst throughput — everything enqueued at once
+            feats, _ = _requests(BURST_REQUESTS, seed=2)
+            t_burst = time.monotonic()
+            burst = [replica.submit(f) for f in feats]
+            for fut in burst:
+                fut.result(timeout=120)
+            burst_wall = time.monotonic() - t_burst
+            status = replica.status()
+        finally:
+            replica.stop()
+    qps = BURST_REQUESTS / burst_wall if burst_wall > 0 else 0.0
+    chips = jax.device_count()
+    return {
+        "buckets": list(buckets),
+        "max_batch_delay_ms": max_delay_s * 1e3,
+        "open_loop": {
+            "requests": N_REQUESTS,
+            "offered_qps": RATE_QPS,
+            "completed": len(latencies),
+            "failed": failed + submit_errors,
+            "latency": _percentiles(latencies),
+        },
+        "burst": {
+            "requests": BURST_REQUESTS,
+            "wall_seconds": round(burst_wall, 3),
+            "qps": round(qps, 1),
+            "qps_per_chip": round(qps / chips, 2),
+        },
+        "bucket_hits": status["bucket_hits"],
+        "batches": sum(status["bucket_hits"].values()),
+        "mean_batch_size": round(
+            status["completed"] / max(1, sum(status["bucket_hits"].values())), 2
+        ),
+    }
+
+
+class _Router:
+    """Round-robin over a mutable replica pool — the bench's stand-in for
+    the controller's service endpoints. Rescale = pool mutation."""
+
+    def __init__(self, replicas):
+        self.replicas = list(replicas)
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def submit(self, features):
+        with self._lock:
+            replica = self.replicas[self._i % len(self.replicas)]
+            self._i += 1
+        return replica.submit(features)
+
+    def add(self, replica):
+        with self._lock:
+            self.replicas.append(replica)
+
+    def remove(self):
+        with self._lock:
+            return self.replicas.pop(0)
+
+
+def run_rescale_arm() -> Dict:
+    from edl_tpu.serving import ServingConfig, ServingReplica
+
+    buckets = (1, 8, 32)
+    with tempfile.TemporaryDirectory() as td:
+        _export_artifact(td)
+
+        def make(i):
+            return ServingReplica(ServingConfig(
+                model_dir=td, buckets=buckets, max_batch_delay_s=0.005,
+                queue_capacity=4096, name=f"bench-rescale-{i}",
+            )).start()
+
+        pool = _Router([make(0), make(1)])
+        timeline = []
+        stopped = []
+
+        def rescale_script():
+            # grow mid-traffic: the new replica AOT-compiles its buckets
+            # BEFORE joining the pool (the warm-join discipline)
+            time.sleep(0.4)
+            replica = make(2)
+            pool.add(replica)
+            timeline.append("t+0.4s grow 2->3 (replica pre-compiled)")
+            # shrink mid-traffic: remove from routing, then drain — every
+            # request already accepted by the leaving replica completes
+            time.sleep(0.4)
+            leaving = pool.remove()
+            timeline.append("t+0.8s shrink 3->2 (drained, zero aborts)")
+            leaving.stop(drain=True)
+            stopped.append(leaving)
+
+        script = threading.Thread(target=rescale_script)
+        script.start()
+        t_start = time.monotonic()
+        futures, submit_errors = _open_loop(pool.submit, N_REQUESTS, seed=1)
+        latencies, dropped = [], 0
+        for fut, record in futures:
+            try:
+                fut.result(timeout=60)
+                latencies.append(record["t_done"] - record["t_submit"])
+            except Exception:  # edl: noqa[EDL005] a dropped in-flight request is THE metric of the rescale arm (must be zero); counted, and non-zero fails the bench exit code
+                dropped += 1
+        wall = time.monotonic() - t_start
+        script.join()
+        completed_per_replica = {}
+        for replica in pool.replicas + stopped:
+            status = replica.status()
+            completed_per_replica[status["name"]] = status["completed"]
+            replica.stop()
+    return {
+        "buckets": list(buckets),
+        "requests": N_REQUESTS,
+        "accepted": len(futures),
+        "submit_rejections": submit_errors,
+        "completed": len(latencies),
+        "dropped_in_flight": dropped,
+        "timeline": timeline,
+        "completed_per_replica": completed_per_replica,
+        "achieved_qps": round(len(latencies) / wall, 1) if wall else 0.0,
+        "latency": _percentiles(latencies),
+    }
+
+
+def main() -> int:
+    import jax
+
+    results = {
+        "bench": "serving tier: continuous batching + rescale-under-traffic",
+        "env": {
+            "backend": jax.default_backend(),
+            "devices": jax.device_count(),
+            "note": ("CPU-sim: absolute latencies are not TPU numbers; "
+                     "batching-on/off deltas, bucket shapes and drop "
+                     "counts are the portable comparisons"),
+        },
+        "offered_load_qps": RATE_QPS,
+        "arms": {},
+        "bucket_table": [],
+    }
+    print(f"== batching on (buckets {BUCKET_CONFIGS[0]}) ==")
+    on = run_arm("on", BUCKET_CONFIGS[0], 0.005)
+    print(json.dumps({**on["open_loop"]["latency"], **on["burst"]}))
+    results["arms"]["batching_on"] = on
+    print("== batching off (bucket ladder (1,), no coalesce) ==")
+    off = run_arm("off", (1,), 0.0)
+    print(json.dumps({**off["open_loop"]["latency"], **off["burst"]}))
+    results["arms"]["batching_off"] = off
+    for buckets in BUCKET_CONFIGS:
+        print(f"== bucket config {buckets} ==")
+        arm = run_arm(f"buckets-{'-'.join(map(str, buckets))}", buckets, 0.005)
+        results["bucket_table"].append(arm)
+    print("== rescale under traffic ==")
+    rescale = run_rescale_arm()
+    print(json.dumps({k: rescale[k] for k in
+                      ("accepted", "completed", "dropped_in_flight")}))
+    results["arms"]["rescale_under_traffic"] = rescale
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=2)
+        f.write("\n")
+    print(f"wrote {OUT}")
+    return 0 if rescale["dropped_in_flight"] == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,6 +18,7 @@ from edl_tpu.api.types import (
     ReplicaSpec,
     ResourceRequirements,
     ScaleRecord,
+    ServingSpec,
     TPUSpec,
     TrainerStatus,
     TrainingJob,
@@ -36,6 +37,7 @@ __all__ = [
     "TPUSpec",
     "TrainerStatus",
     "TrainingJob",
+    "ServingSpec",
     "TrainingJobSpec",
     "TrainingJobStatus",
     "ValidationError",

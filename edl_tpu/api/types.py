@@ -147,6 +147,41 @@ class ReplicaSpec:
 
 
 @dataclass
+class ServingSpec:
+    """Marks a job as a serving-tier job: its replicas run the
+    continuous-batching inference frontend (`edl_tpu.serving`) over the
+    artifact at ``model_dir`` instead of a train loop, and the autoscaler
+    scales them on scraped `edl_serve_*` p99 latency + queue depth
+    instead of cluster utilization."""
+
+    model_dir: str = ""
+    buckets: List[int] = field(default_factory=lambda: [1, 8, 32])
+    #: grow a replica when the tier p99 breaches this
+    slo_p99_seconds: float = 0.25
+    #: ... or the mean queue backlog per replica exceeds this
+    max_queue_per_replica: float = 8.0
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["ServingSpec"]:
+        if d is None:
+            return None
+        return cls(
+            model_dir=d.get("model_dir", d.get("model-dir", "")),
+            buckets=[int(b) for b in d.get("buckets", [1, 8, 32])],
+            slo_p99_seconds=float(d.get("slo_p99_seconds", 0.25)),
+            max_queue_per_replica=float(d.get("max_queue_per_replica", 8.0)),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "model_dir": self.model_dir,
+            "buckets": list(self.buckets),
+            "slo_p99_seconds": self.slo_p99_seconds,
+            "max_queue_per_replica": self.max_queue_per_replica,
+        }
+
+
+@dataclass
 class TrainingJobSpec:
     """Job spec (ref: pkg/resource/training_job.go:61-106).
 
@@ -175,6 +210,8 @@ class TrainingJobSpec:
     #: projecting a K8s Secret; the reference's etcd sidecar had no auth
     #: at all (pkg/jobparser.go:167-184).
     auth_token: str = ""
+    #: non-None marks a serving-tier job (see :class:`ServingSpec`)
+    serving: Optional["ServingSpec"] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]) -> "TrainingJobSpec":
@@ -192,10 +229,11 @@ class TrainingJobSpec:
             checkpoint_interval=int(d.get("checkpoint_interval", 1000)),
             checkpoint_dir=d.get("checkpoint_dir", ""),
             auth_token=d.get("auth_token", ""),
+            serving=ServingSpec.from_dict(d.get("serving")),
         )
 
     def to_dict(self) -> dict:
-        return {
+        out = {
             "image": self.image,
             "port": self.port,
             "fault_tolerant": self.fault_tolerant,
@@ -209,6 +247,9 @@ class TrainingJobSpec:
             "checkpoint_dir": self.checkpoint_dir,
             "auth_token": self.auth_token,
         }
+        if self.serving is not None:
+            out["serving"] = self.serving.to_dict()
+        return out
 
 
 @dataclass
@@ -252,6 +293,11 @@ class TrainingJob:
     def elastic(self) -> bool:
         """Elastic iff the trainer instance range is a real range."""
         return self.spec.trainer.min_instance < self.spec.trainer.max_instance
+
+    def serving(self) -> bool:
+        """True for serving-tier jobs: replicas run the inference frontend
+        and scale on SLO signals, not cluster utilization."""
+        return self.spec.serving is not None
 
     def need_tpu(self) -> bool:
         return self.spec.tpu.chips_per_trainer > 0

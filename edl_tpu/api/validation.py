@@ -75,6 +75,20 @@ def validate(job: TrainingJob) -> TrainingJob:
             f"parallelism axes product {axis_product} must divide "
             f"chips_per_trainer {local_chips}"
         )
+    if spec.serving is not None:
+        s = spec.serving
+        if not s.model_dir:
+            raise ValidationError("serving.model_dir is required")
+        if not s.buckets or any(b <= 0 for b in s.buckets) \
+                or any(a >= b for a, b in zip(s.buckets, s.buckets[1:])):
+            raise ValidationError(
+                f"serving.buckets must be positive and strictly "
+                f"ascending, got {s.buckets}"
+            )
+        if s.slo_p99_seconds <= 0:
+            raise ValidationError("serving.slo_p99_seconds must be > 0")
+        if s.max_queue_per_replica <= 0:
+            raise ValidationError("serving.max_queue_per_replica must be > 0")
     return job
 
 

@@ -11,7 +11,11 @@ kubectl-side workflow its docs walk through (`doc/usage.md:81-118`):
 - ``train``     — run a model from the zoo locally on the live JAX backend
   (the `train_local.py` twin, `example/fit_a_line/train_local.py:41-109`).
 - ``status``    — query a running coordinator's counters (ops, fsyncs,
-  journal records, per-worker leases) over the wire protocol.
+  journal records, per-worker leases) over the wire protocol, plus any
+  serving replicas' published state (version, queue, bucket hit-rates).
+- ``serve``     — run one inference replica over an exported artifact:
+  the continuous-batching frontend (`edl_tpu.serving`) with /predict,
+  /metrics and rolling model-version swap.
 
 ``--log-format json`` (anywhere on the command line) switches every
 subcommand to one-JSON-object-per-line logging (`edl_tpu.obs.logs`).
@@ -222,13 +226,20 @@ def cmd_status(args) -> int:
             # member. Best-effort: an old coordinator without members/kv
             # just shows no policy section.
             policies = {}
+            # Serving replicas publish their queue/bucket/swap state under
+            # edl/serving/<worker> (serving.worker) the same way.
+            serving = {}
             try:
                 for member in client.members():
                     raw = client.kv_get(f"edl/ft_policy/{member}")
                     if raw:
                         policies[member] = json.loads(raw)
+                    raw = client.kv_get(f"edl/serving/{member}")
+                    if raw:
+                        serving[member] = json.loads(raw)
             except (CoordinatorError, ValueError):
                 policies = {}
+                serving = {}
     except (CoordinatorError, OSError) as e:
         print(f"ERROR: {e}", file=sys.stderr)
         return 1
@@ -236,6 +247,8 @@ def cmd_status(args) -> int:
     if args.json:
         if policies:
             status = dict(status, ft_policy=policies)
+        if serving:
+            status = dict(status, serving=serving)
         print(json.dumps(status, indent=2, sort_keys=True))
         return 0 if ok else 1
     counters = [
@@ -267,6 +280,18 @@ def cmd_status(args) -> int:
                   f"threshold={st.get('threshold')}s "
                   f"incidents={st.get('incidents')} "
                   f"storm={st.get('storm')}")
+    if serving:
+        print("  serving replicas:")
+        for worker, st in sorted(serving.items()):
+            hits = st.get("bucket_hits") or {}
+            hits_s = ",".join(f"{k}:{v}" for k, v in sorted(
+                hits.items(), key=lambda kv: int(kv[0]))) or "-"
+            print(f"    {worker:<24} version={st.get('version')} "
+                  f"step={st.get('model_step')} "
+                  f"queue={st.get('queue_depth')} "
+                  f"buckets={hits_s} "
+                  f"last_swap_step={st.get('last_swap_step')} "
+                  f"served={st.get('completed')}")
     return 0 if ok else 1
 
 
@@ -295,6 +320,38 @@ def cmd_train(args) -> int:
     state, metrics = trainer.run(state, batches())
     print(json.dumps({k: round(v, 4) for k, v in metrics.items()}))
     return 0
+
+
+def cmd_serve(args) -> int:
+    """Run one serving replica over an exported artifact until Ctrl-C."""
+    from edl_tpu.serving import ServingConfig, ServingReplica
+
+    client = None
+    if args.coordinator:
+        from edl_tpu.coordinator.client import CoordinatorClient
+
+        host, _, port = args.coordinator.partition(":")
+        client = CoordinatorClient(host, int(port or 7164), worker=args.name,
+                                   token=args.token)
+    config = ServingConfig(
+        model_dir=args.model_dir,
+        buckets=tuple(int(b) for b in args.buckets.split(",")),
+        max_batch_delay_s=args.max_batch_delay / 1000.0,
+        port=args.port,
+        name=args.name,
+        version_poll_s=args.version_poll,
+    )
+    replica = ServingReplica(config, client=client).start()
+    log = logging.getLogger("edl_tpu")
+    log.info("serving %s at %s (buckets %s); Ctrl-C to stop",
+             args.model_dir, replica.url, config.buckets)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        replica.stop()
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -349,6 +406,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--timeout", type=float, default=5.0)
     p.add_argument("--json", action="store_true", help="print the raw status reply")
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("serve",
+                       help="serve an exported artifact (continuous batching)",
+                       parents=[common])
+    p.add_argument("--model-dir", required=True,
+                   help="export directory (versioned LATEST or flat layout)")
+    p.add_argument("--buckets", default="1,8,32",
+                   help="comma-separated batch bucket ladder")
+    p.add_argument("--port", type=int, default=8476,
+                   help="HTTP port for /predict + /metrics (0 = ephemeral)")
+    p.add_argument("--max-batch-delay", type=float, default=5.0,
+                   help="batch coalesce window, milliseconds (0 = off)")
+    p.add_argument("--version-poll", type=float, default=0.25,
+                   help="LATEST-pointer poll period, seconds")
+    p.add_argument("--name", default="serve-0",
+                   help="replica name (coordinator member + KV status key)")
+    p.add_argument("--coordinator", default="",
+                   help="host:port to publish status to (optional)")
+    p.add_argument("--token", default=None,
+                   help="job auth token (default: $EDL_COORD_TOKEN)")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("train", help="train a zoo model locally", parents=[common])
     p.add_argument("--model", default="fit_a_line")

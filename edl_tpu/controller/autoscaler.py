@@ -285,6 +285,13 @@ class Autoscaler:
         self._events: "queue.Queue[_Event]" = queue.Queue()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: serving-job replica endpoints (job -> urls or callable returning
+        #: urls): where the SLO pass scrapes `edl_serve_*` from. Registered
+        #: by whoever launches the replicas (deploy glue, tests, bench).
+        self._serve_endpoints: Dict[str, object] = {}
+        #: injectable scrape (tests/bench swap in fakes; the default hits
+        #: each replica's /metrics over HTTP).
+        self.serve_scrape: Optional[Callable] = None
         #: most recent plan, for observability/collector (job -> target).
         self.last_plan: Dict[str, int] = {}
         #: optional actuation listener (job_name, ScaleRecord) — the controller
@@ -297,6 +304,25 @@ class Autoscaler:
         self.actuator = None
 
     # -- informer-style callbacks (ref: autoscaler.go:158-171) -----------------
+
+    def register_serving_endpoints(self, job_name: str, endpoints) -> None:
+        """Tell the SLO pass where ``job_name``'s replicas expose /metrics.
+        ``endpoints``: a list of base URLs, or a callable returning one
+        (live replica sets change as this very autoscaler scales them)."""
+        self._serve_endpoints[job_name] = endpoints
+
+    def _serving_urls(self, job_name: str) -> List[str]:
+        endpoints = self._serve_endpoints.get(job_name)
+        if endpoints is None:
+            return []
+        if callable(endpoints):
+            try:
+                return list(endpoints())
+            except Exception:  # edl: noqa[EDL005] a broken endpoint resolver reads as "no scrapes" — the SLO pass then holds rather than flaps; logged for the operator
+                log.exception("serving endpoint resolver for %s failed",
+                              job_name)
+                return []
+        return list(endpoints)
 
     def on_add(self, job: TrainingJob) -> None:
         self._events.put(_Event("add", job))
@@ -351,9 +377,13 @@ class Autoscaler:
             s.current = self.cluster.get_trainer_parallelism(s.name)
         snapshot = self.cluster.inquire()
         pending = self._pending_jobs()
+        reasons: Dict[str, str] = {}
         if pending:
             # Make-room mode: shrink running jobs so pending pods can place;
-            # pending jobs themselves are never shrink victims.
+            # pending jobs themselves are never shrink victims. Serving jobs
+            # participate on BOTH sides — a pending serving job triggers
+            # shrinks, and a serving job above its floor is a valid victim
+            # (shrink-to-admit does not care what a replica computes).
             pending_reqs = [
                 p.requests
                 for name in pending
@@ -362,10 +392,22 @@ class Autoscaler:
             ]
             shrink_states = [s for s in elastic if s.name not in pending]
             diff = make_room_dry_run(snapshot, shrink_states, pending_reqs)
-            reason = "make-room"
+            reasons = {name: "make-room" for name in diff}
         else:
-            diff = scale_all_dry_run(snapshot, elastic, self.config.max_load_desired)
-            reason = "autoscale"
+            # Serving jobs scale on their scraped SLO signal, never on
+            # cluster utilization — the pass runs FIRST and accounts its
+            # grows/shrinks into the snapshot, so the training fixed point
+            # sees serving demand as already-spent capacity.
+            serving_states = [s for s in elastic if s.job.serving()]
+            training_states = [s for s in elastic if not s.job.serving()]
+            diff = self._serving_pass(snapshot, serving_states)
+            reasons = {name: "serving-slo" for name in diff}
+            training_diff = scale_all_dry_run(
+                snapshot, training_states, self.config.max_load_desired
+            )
+            for name, d in training_diff.items():
+                diff[name] = diff.get(name, 0) + d
+                reasons.setdefault(name, "autoscale")
         target = {
             s.name: s.current + diff.get(s.name, 0)
             for s in elastic
@@ -374,9 +416,51 @@ class Autoscaler:
         self.last_plan = dict(target)  # edl: noqa[EDL006] atomic reference swap under the GIL; observers (CLI/status) read the previous complete plan or the new one, never a partial dict
         _M_PLAN_JOBS.set(float(len(target)))
         if target:
-            log.info("scaling plan: %s (%s)", target, reason)
-        self._actuate(target, reason)
+            log.info("scaling plan: %s (%s)", target,
+                     {n: reasons.get(n, "autoscale") for n in target})
+        for reason in sorted(set(reasons.get(n, "autoscale") for n in target)):
+            self._actuate(
+                {n: t for n, t in target.items()
+                 if reasons.get(n, "autoscale") == reason},
+                reason,
+            )
         return target
+
+    def _serving_pass(self, resource: ClusterResource,
+                      states: List[JobState]) -> Dict[str, int]:
+        """SLO-driven replica deltas for serving jobs, committed through the
+        same node-fit accounting as training scale-ups (a serving grow that
+        doesn't fit stays 0 — make-room picks it up once the pod pends).
+        Mutates ``resource`` so the caller's later passes see the spend."""
+        diff: Dict[str, int] = {}
+        if not states:
+            return diff
+        from edl_tpu.serving.autoscale import (ServingSLO,
+                                               desired_replica_delta,
+                                               scrape_serve_signal)
+
+        scrape = self.serve_scrape or scrape_serve_signal
+        for s in states:
+            urls = self._serving_urls(s.name)
+            signals = [sig for sig in (scrape(u) for u in urls)
+                       if sig is not None]
+            if not signals:
+                continue  # nothing scraped: hold, never flap blind
+            spec = s.job.spec.serving
+            slo = ServingSLO(
+                p99_seconds=spec.slo_p99_seconds,
+                max_queue_per_replica=spec.max_queue_per_replica,
+            )
+            delta = desired_replica_delta(signals, slo)
+            if delta > 0 and s.current < s.max_instance():
+                node = resource.search_assignable_node(s.request())
+                if node is not None:
+                    resource.assign(node, s.request())
+                    diff[s.name] = 1
+            elif delta < 0 and s.current > s.min_instance():
+                resource.release_any(s.request())
+                diff[s.name] = -1
+        return diff
 
     def _pending_jobs(self) -> List[str]:
         """Jobs whose trainer pods are all pending — they need room made

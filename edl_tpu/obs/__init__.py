@@ -33,8 +33,8 @@ anatomy (`RESCALE_TIMELINE.json`).
 """
 
 from edl_tpu.obs.bridge import CoordinatorStatusBridge
-from edl_tpu.obs.http import MetricsServer, scrape_metrics
-from edl_tpu.obs.instruments import WorkerInstruments
+from edl_tpu.obs.http import MetricsServer, ObsRequestHandler, scrape_metrics
+from edl_tpu.obs.instruments import ServeInstruments, WorkerInstruments
 from edl_tpu.obs.logs import JsonLogFormatter, configure_logging
 from edl_tpu.obs.metrics import (
     Counter,
@@ -67,8 +67,10 @@ __all__ = [
     "rescale_timeline",
     "rescale_trace_id",
     "MetricsServer",
+    "ObsRequestHandler",
     "scrape_metrics",
     "CoordinatorStatusBridge",
+    "ServeInstruments",
     "WorkerInstruments",
     "JsonLogFormatter",
     "configure_logging",

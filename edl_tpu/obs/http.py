@@ -19,7 +19,7 @@ from typing import Callable, Dict, Optional
 from edl_tpu.obs.metrics import MetricsRegistry, get_registry
 from edl_tpu.obs.tracing import Tracer, get_tracer
 
-__all__ = ["MetricsServer", "scrape_metrics"]
+__all__ = ["MetricsServer", "ObsRequestHandler", "scrape_metrics"]
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -64,6 +64,11 @@ class _Handler(BaseHTTPRequestHandler):
         pass  # scrapes every few seconds must not spam the pod log
 
 
+#: public alias for subclassing: the serving frontend extends this handler
+#: with `do_POST /predict` while inheriting /metrics, /healthz and /spans.
+ObsRequestHandler = _Handler
+
+
 class MetricsServer:
     """Serve the registry (and tracer) over HTTP on a daemon thread.
 
@@ -76,12 +81,16 @@ class MetricsServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[Tracer] = None,
                  host: str = "0.0.0.0", port: int = 0,
-                 health: Optional[Callable[[], Dict]] = None):
+                 health: Optional[Callable[[], Dict]] = None,
+                 handler_cls: type = _Handler,
+                 handler_attrs: Optional[Dict] = None):
         self.registry = registry if registry is not None else get_registry()
         self.tracer = tracer
         self.host = host
         self.port = port
         self.health = health
+        self.handler_cls = handler_cls
+        self.handler_attrs = dict(handler_attrs or {})
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -90,7 +99,7 @@ class MetricsServer:
             return self
         registry, tracer, health = self.registry, self.tracer, self.health
 
-        class Handler(_Handler):
+        class Handler(self.handler_cls):
             pass
 
         Handler.registry = registry
@@ -100,6 +109,11 @@ class MetricsServer:
         # unwanted first argument (bound methods happened to work, functions
         # and lambdas broke).
         Handler.health = None if health is None else staticmethod(health)
+        for key, value in self.handler_attrs.items():
+            # same binding trap as `health`: bare functions become methods.
+            if isinstance(value, type(scrape_metrics)):
+                value = staticmethod(value)
+            setattr(Handler, key, value)
         httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         httpd.daemon_threads = True
         self._httpd = httpd

@@ -14,13 +14,21 @@ from typing import Optional
 
 from edl_tpu.obs.metrics import MetricsRegistry, get_registry
 
-__all__ = ["WorkerInstruments", "FTPolicyInstruments", "OUTAGE_BUCKETS"]
+__all__ = ["WorkerInstruments", "FTPolicyInstruments", "ServeInstruments",
+           "OUTAGE_BUCKETS", "SERVE_LATENCY_BUCKETS"]
 
 #: outage-duration buckets: sub-second blips through multi-minute storms.
 #: The default latency buckets top out at 60 s — exactly where the park
 #: decision gets interesting — so outages get their own scale.
 OUTAGE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                   120.0, 300.0, 600.0)
+
+#: request-latency buckets: the serving SLO lives in the 1 ms - 1 s band
+#: (queue wait + pad + device step), far below the default latency
+#: buckets' 60 s ceiling. The autoscaler computes its p99 from these
+#: cumulative buckets, so the resolution here bounds its signal quality.
+SERVE_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                         0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class WorkerInstruments:
@@ -111,6 +119,68 @@ class WorkerInstruments:
     def note_epoch(self, epoch: int) -> None:
         self.epoch.set(float(epoch))
         self.epoch_observations.inc()
+
+
+class ServeInstruments:
+    """The serving replica's sensor suite: request latency (the autoscaler's
+    p99 source), queue depth (its second signal), per-bucket dispatch
+    counts (bucket-config tuning), and model-swap progress. One scrape
+    answers both "is this replica keeping up?" and "which artifact version
+    is it serving?"."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.requests = r.counter(
+            "edl_serve_requests_total",
+            "requests finished, by outcome",
+            labelnames=("outcome",),  # ok | error | rejected
+        )
+        self.latency = r.histogram(
+            "edl_serve_request_latency_seconds",
+            "enqueue-to-result latency per request (queue wait + padding + "
+            "device step); the autoscaler's p99 is computed from these "
+            "cumulative buckets",
+            buckets=SERVE_LATENCY_BUCKETS,
+        )
+        self.queue_wait = r.histogram(
+            "edl_serve_queue_wait_seconds",
+            "time a request sat queued before its batch was formed",
+            buckets=SERVE_LATENCY_BUCKETS,
+        )
+        self.queue_depth = r.gauge(
+            "edl_serve_queue_depth",
+            "requests currently queued (sampled at enqueue and dispatch)",
+        )
+        self.inflight = r.gauge(
+            "edl_serve_inflight_requests",
+            "requests accepted and not yet resolved",
+        )
+        self.batches = r.counter(
+            "edl_serve_batches_total",
+            "batches dispatched, by bucket size (the bucket hit-rate table)",
+            labelnames=("bucket",),
+        )
+        self.batch_occupancy = r.histogram(
+            "edl_serve_batch_occupancy",
+            "real requests / bucket slots per dispatched batch (1.0 = no "
+            "padding waste; persistently low occupancy means the bucket "
+            "ladder is too coarse or max_batch_delay too short)",
+            buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        )
+        self.model_step = r.gauge(
+            "edl_serve_model_step",
+            "training step of the artifact currently being served",
+        )
+        self.model_swaps = r.counter(
+            "edl_serve_model_swaps_total",
+            "rolling model-version swaps completed without dropping requests",
+        )
+        self.compile_seconds = r.gauge(
+            "edl_serve_compile_seconds",
+            "AOT compile time per bucket executable (paid before the first "
+            "request, never on the request path)",
+            labelnames=("bucket",),
+        )
 
 
 class FTPolicyInstruments:

@@ -23,7 +23,9 @@ from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker, RescaleEvent
 from edl_tpu.runtime.export import (
     InferenceModel,
     PeriodicExporter,
+    artifact_version,
     load_inference_model,
+    resolve_artifact_dir,
     save_inference_model,
 )
 from edl_tpu.runtime.multihost import MultiHostWorker
@@ -51,9 +53,11 @@ __all__ = [
     "WireCodec",
     "WireRestartRequired",
     "abstract_like",
+    "artifact_version",
     "distributed_init",
     "live_state_specs",
     "load_inference_model",
+    "resolve_artifact_dir",
     "save_inference_model",
     "pass_task",
     "pass_tasks",

@@ -35,21 +35,42 @@ the filesystem.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shutil
 import tempfile
+import threading
 import time
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
+from edl_tpu.obs.metrics import get_registry
+
 __all__ = ["save_inference_model", "load_inference_model", "InferenceModel",
-           "PeriodicExporter"]
+           "PeriodicExporter", "artifact_version", "resolve_artifact_dir",
+           "LATEST"]
+
+log = logging.getLogger("edl_tpu.runtime.export")
 
 MANIFEST = "manifest.json"
+#: atomic pointer file in a versioned export root naming the newest
+#: complete version directory — the serving tier's swap watcher reads this
+LATEST = "LATEST"
+_VERSION_PREFIX = "v"
 _FORMAT = 1
+
+#: same family train_loop counts hot-loop retraces into (get-or-create by
+#: name shares the instrument without importing the trainer): a predict
+#: retrace past the first shape is the same performance bug on the serving
+#: side — the bucketed frontend exists so it never fires steady-state.
+_M_RETRACES = get_registry().counter(
+    "edl_trainer_retraces_total",
+    "steady-state jit recompilations (shape/dtype churn in the hot loop)",
+)
 #: weights files kept besides the live one: grace for a reader that loaded
 #: an older manifest just before a newer export landed
 #: orphaned .tmp files older than this are swept during the GC pass
@@ -203,6 +224,101 @@ def _write_artifact(directory, model_ref, host_flat, config, step) -> None:
                 pass  # already gone or being replaced
 
 
+def _read_latest(directory: str) -> Optional[str]:
+    try:
+        with open(os.path.join(directory, LATEST)) as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    return name or None
+
+
+def resolve_artifact_dir(directory: str) -> str:
+    """Follow a versioned root's ``LATEST`` pointer to the version directory
+    it names; a flat (unversioned) artifact directory resolves to itself."""
+    name = _read_latest(directory)
+    if name:
+        candidate = os.path.join(directory, name)
+        if os.path.isdir(candidate):
+            return candidate
+    return directory
+
+
+def artifact_version(directory: str) -> Optional[Tuple]:
+    """Published-artifact identity ``(step, weights_name, dir_name)`` or
+    ``None`` when nothing complete is published. This is what the serving
+    tier's swap watcher polls: LATEST is replaced atomically only after a
+    version directory is complete, so the identity can never name a
+    half-written export."""
+    resolved = resolve_artifact_dir(directory)
+    try:
+        with open(os.path.join(resolved, MANIFEST)) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return (manifest.get("step"), manifest.get("weights"),
+            os.path.basename(resolved))
+
+
+def _version_step(name: str) -> Optional[int]:
+    try:
+        return int(name[len(_VERSION_PREFIX):])
+    except (ValueError, TypeError):
+        return None  # step-less "vfinal-<uuid>" dirs are unordered
+
+
+def _write_versioned(directory, model_ref, host_flat, config, step) -> None:
+    """One complete artifact per ``v<step>`` subdirectory, published by
+    atomically replacing the ``LATEST`` pointer AFTER the directory is
+    complete. A writer that crashes mid-export leaves an orphan directory
+    LATEST never pointed at — readers keep getting the previous complete
+    version, and the orphan is swept (age-gated) on a later export."""
+    os.makedirs(directory, exist_ok=True)
+    prev = _read_latest(directory)
+    prev_step = _version_step(prev) if prev else None
+    if step is not None and prev_step is not None and prev_step >= step:
+        return  # same high-water regression guard as the flat layout
+    if step is not None:
+        vname = f"{_VERSION_PREFIX}{int(step):010d}"  # lexical == numeric
+    else:
+        import uuid
+
+        vname = f"{_VERSION_PREFIX}final-{uuid.uuid4().hex[:8]}"
+    _write_artifact(os.path.join(directory, vname), model_ref, host_flat,
+                    config, step)
+    fd, tmp = tempfile.mkstemp(dir=directory, suffix=".latest.tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(vname)
+    os.replace(tmp, os.path.join(directory, LATEST))
+    # GC: keep the generation LATEST names plus the one it just replaced
+    # (grace for a reader that resolved the old pointer moments ago);
+    # every other COMPLETE version is unreachable and goes. Incomplete
+    # orphans (crashed writer) are swept only once aged, mirroring the
+    # tmp-file sweep — a slow concurrent writer's live directory survives.
+    spare = {vname, prev}
+    now = time.time()
+    for name in os.listdir(directory):
+        full = os.path.join(directory, name)
+        if (name in spare or not name.startswith(_VERSION_PREFIX)
+                or not os.path.isdir(full)):
+            continue
+        complete = os.path.exists(os.path.join(full, MANIFEST))
+        try:
+            aged = now - os.path.getmtime(full) > _TMP_SWEEP_AGE_SEC
+        except OSError:
+            continue  # raced with another sweep
+        if complete or aged:
+            shutil.rmtree(full, ignore_errors=True)
+    for name in os.listdir(directory):
+        if name.endswith(".latest.tmp"):
+            full = os.path.join(directory, name)
+            try:
+                if now - os.path.getmtime(full) > _TMP_SWEEP_AGE_SEC:
+                    os.unlink(full)
+            except OSError:
+                pass  # already gone or being replaced
+
+
 def save_inference_model(
     directory: str,
     model_ref: str,
@@ -210,6 +326,7 @@ def save_inference_model(
     config: Optional[Dict[str, Any]] = None,
     step: Optional[int] = None,
     write: bool = True,
+    versioned: bool = False,
 ) -> None:
     """Write the serving artifact for ``params`` of zoo model ``model_ref``.
 
@@ -217,16 +334,35 @@ def save_inference_model(
     ``config`` the ``make_model`` kwargs that built the trained variant
     (omit for the module's default ``MODEL``). In multi-process jobs every
     rank must call this at the same step (the gather is collective) with
-    ``write=True`` on exactly one rank.
+    ``write=True`` on exactly one rank. ``versioned=True`` writes each
+    export to its own ``v<step>`` subdirectory and atomically advances the
+    ``LATEST`` pointer (the layout the serving tier's swap watcher needs).
     """
     host_flat = _gather_host(params)
     if write:
-        _write_artifact(directory, model_ref, host_flat, config, step)
+        writer = _write_versioned if versioned else _write_artifact
+        writer(directory, model_ref, host_flat, config, step)
+
+
+def _batch_signature(batch: Dict[str, Any]) -> Tuple:
+    """Hashable per-key (shape, dtype) of a feature batch — what a jitted
+    predict executable is specialized to. Key-order independent."""
+    return tuple(sorted(
+        (k, tuple(np.shape(v)), str(getattr(v, "dtype", None)
+                                    or np.asarray(v).dtype))
+        for k, v in batch.items()
+    ))
 
 
 @dataclass
 class InferenceModel:
-    """A loaded serving artifact: rebuilt model + placed params."""
+    """A loaded serving artifact: rebuilt model + placed params.
+
+    ``predict`` is thread-safe: the executable cache is keyed per batch
+    aval under a lock, so a threaded frontend racing two first calls
+    builds one executable, and distinct batch shapes each compile exactly
+    once (counted as retraces past the first — the continuous-batching
+    frontend's bucket ladder exists so that count stays flat)."""
 
     model: Any
     params: Any
@@ -235,7 +371,8 @@ class InferenceModel:
     config: Dict[str, Any]
 
     def __post_init__(self):
-        self._jit_predict = None
+        self._predict_lock = threading.Lock()
+        self._predict_cache: Dict[Tuple, Any] = {}
 
     def predict(self, batch: Dict[str, np.ndarray]):
         """Jitted forward through the zoo model's ``predict`` entrypoint."""
@@ -243,13 +380,25 @@ class InferenceModel:
             raise NotImplementedError(
                 f"model {self.model.name!r} defines no predict entrypoint"
             )
-        if self._jit_predict is None:
-            mesh = self.mesh
-            pred = self.model.predict
-            self._jit_predict = jax.jit(
-                lambda params, b: pred(params, b, mesh)
-            )
-        return self._jit_predict(self.params, batch)
+        sig = _batch_signature(batch)
+        with self._predict_lock:
+            fn = self._predict_cache.get(sig)
+            if fn is None:
+                if self._predict_cache:
+                    # a second shape means the caller is feeding unbucketed
+                    # batches — each new shape pays a full trace+compile
+                    _M_RETRACES.inc()
+                    log.warning(
+                        "predict retrace: new batch signature %s "
+                        "(%d already cached) — pad to fixed buckets to "
+                        "avoid per-shape compiles", sig,
+                        len(self._predict_cache),
+                    )
+                mesh = self.mesh
+                pred = self.model.predict
+                fn = jax.jit(lambda params, b: pred(params, b, mesh))
+                self._predict_cache[sig] = fn
+        return fn(self.params, batch)
 
 
 def _spec_axes(spec_tree) -> set:
@@ -297,6 +446,7 @@ def load_inference_model(
     """
     from edl_tpu import models as zoo
 
+    directory = resolve_artifact_dir(directory)
     with open(os.path.join(directory, MANIFEST)) as f:
         manifest = json.load(f)
     if manifest.get("format") != _FORMAT:
@@ -357,6 +507,7 @@ class PeriodicExporter:
         config: Optional[Dict[str, Any]] = None,
         rank: int = 0,
         writer_rank: int = 0,
+        versioned: bool = False,
     ):
         self.directory = directory
         self.model_ref = model_ref
@@ -364,6 +515,10 @@ class PeriodicExporter:
         self.config = config
         self.rank = rank
         self.writer_rank = writer_rank
+        #: versioned=True: each export lands in its own v<step> dir and the
+        #: atomic LATEST pointer advances only once the dir is complete —
+        #: required when a serving tier's swap watcher polls this directory.
+        self.versioned = versioned
         self.exports = 0
         #: high-water mark, not last-seen: a post-restore replay re-visits
         #: old step numbers, and re-exporting step 104 after publishing 148
@@ -390,8 +545,9 @@ class PeriodicExporter:
             self._pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="edl-export"
             )
+        writer = _write_versioned if self.versioned else _write_artifact
         self._inflight = self._pool.submit(
-            _write_artifact, self.directory, self.model_ref, host_flat,
+            writer, self.directory, self.model_ref, host_flat,
             self.config, step,
         )
         self.exports += 1

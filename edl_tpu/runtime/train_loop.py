@@ -52,6 +52,11 @@ def _aval_signature(tree: Any) -> Tuple:
     )
 
 
+#: public name — the serving tier keys its params-swap compatibility check
+#: on the same signature its AOT bucket executables were specialized to.
+aval_signature = _aval_signature
+
+
 class _WarmStep(NamedTuple):
     """An AOT-compiled step executable and the avals it is specialized to."""
 

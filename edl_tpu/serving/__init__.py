@@ -1,0 +1,70 @@
+"""Elastic inference serving tier: the second half of the production loop.
+
+The reference EDL's pitch is one cluster whose capacity flows to wherever
+the load is — but training is only half of that story. This package
+serves what `runtime/export.py` publishes:
+
+- :mod:`edl_tpu.serving.batcher` — the pure bucket-ladder math under
+  continuous batching (pick/pad/split, numpy-only).
+- :mod:`edl_tpu.serving.worker` — :class:`ServingReplica`: AOT-compiles
+  one predict executable per batch bucket before the first request (the
+  PR 2 warm-compile contract — the jit dispatch cache stays empty), runs
+  the continuous-batching dispatch loop, and hot-swaps model versions
+  behind the exporter's atomic ``LATEST`` pointer with zero dropped
+  requests.
+- :mod:`edl_tpu.serving.frontend` — ``POST /predict`` + the obs surface
+  (`/metrics`, `/healthz`, `/spans`) on one stdlib HTTP port.
+- :mod:`edl_tpu.serving.autoscale` — the SLO signal (p99 from scraped
+  histogram buckets, queue depth) the controller autoscaler scales
+  serving replicas on, instead of cluster utilization.
+
+``python -m edl_tpu.serving`` is the serve-smoke deploy gate: export an
+artifact, boot a replica, push requests through the real HTTP frontend,
+scrape `/metrics`, and assert the latency/queue families and the
+empty-dispatch-cache AOT contract. See doc/serving.md.
+"""
+
+from edl_tpu.serving.autoscale import (
+    ServeSignal,
+    ServingSLO,
+    aggregate_signals,
+    desired_replica_delta,
+    histogram_quantile,
+    scrape_serve_signal,
+)
+from edl_tpu.serving.batcher import (
+    pad_batch,
+    pick_bucket,
+    plan_chunks,
+    split_rows,
+    validate_buckets,
+)
+from edl_tpu.serving.frontend import ServeRequestHandler, make_frontend
+from edl_tpu.serving.worker import (
+    SERVING_KV_PREFIX,
+    ServeCompileError,
+    ServeOverloadError,
+    ServingConfig,
+    ServingReplica,
+)
+
+__all__ = [
+    "SERVING_KV_PREFIX",
+    "ServeCompileError",
+    "ServeOverloadError",
+    "ServeRequestHandler",
+    "ServeSignal",
+    "ServingConfig",
+    "ServingReplica",
+    "ServingSLO",
+    "aggregate_signals",
+    "desired_replica_delta",
+    "histogram_quantile",
+    "make_frontend",
+    "pad_batch",
+    "pick_bucket",
+    "plan_chunks",
+    "scrape_serve_signal",
+    "split_rows",
+    "validate_buckets",
+]

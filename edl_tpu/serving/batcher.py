@@ -1,0 +1,94 @@
+"""Bucketed continuous batching: the pure math under the serving frontend.
+
+A jitted predict executable is specialized to one batch shape, so a
+frontend that forwards whatever batch size arrived retraces per shape
+(the exact bug `InferenceModel.predict` counts as retraces). The classic
+fix — XLA serving, batching on TPU pods — is a small ladder of fixed
+bucket sizes: coalesce queued requests, pad up to the smallest bucket
+that fits, and dispatch an executable compiled once per bucket. This
+module holds the ladder math and the pad/split plumbing; it is numpy-pure
+(no jax, no threads) so every edge case is unit-testable in microseconds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["pick_bucket", "plan_chunks", "pad_batch", "split_rows",
+           "validate_buckets"]
+
+
+def validate_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
+    """Normalize a bucket ladder: positive, strictly ascending, non-empty."""
+    out = tuple(int(b) for b in buckets)
+    if not out:
+        raise ValueError("bucket ladder must be non-empty")
+    if any(b <= 0 for b in out):
+        raise ValueError(f"bucket sizes must be positive: {out}")
+    if any(b >= c for b, c in zip(out, out[1:])):
+        raise ValueError(f"bucket ladder must be strictly ascending: {out}")
+    return out
+
+
+def pick_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``n`` requests; the largest bucket when
+    none does (the caller chunks first via :func:`plan_chunks`)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def plan_chunks(n: int, buckets: Sequence[int]) -> List[int]:
+    """Split ``n`` queued requests into dispatchable chunk sizes: full
+    largest-buckets first, remainder in the smallest bucket that fits.
+    ``sum(plan_chunks(n, ...)) == n`` always — no request is left behind."""
+    chunks: List[int] = []
+    largest = buckets[-1]
+    while n > largest:
+        chunks.append(largest)
+        n -= largest
+    if n:
+        chunks.append(n)
+    return chunks
+
+
+def pad_batch(
+    rows: List[Dict[str, np.ndarray]],
+    bucket: int,
+    feature_avals: Dict[str, Tuple[Tuple[int, ...], np.dtype]],
+) -> Dict[str, np.ndarray]:
+    """Stack per-request feature rows and zero-pad to ``bucket`` slots.
+
+    ``rows`` are single-example dicts (no batch dim); ``feature_avals``
+    maps key -> (per-example shape, dtype) and is the authority for both —
+    a row missing a key or shaped differently raises rather than padding
+    garbage into the model.
+    """
+    if len(rows) > bucket:
+        raise ValueError(f"{len(rows)} rows exceed bucket {bucket}")
+    out: Dict[str, np.ndarray] = {}
+    for key, (shape, dtype) in feature_avals.items():
+        arr = np.zeros((bucket,) + tuple(shape), dtype=dtype)
+        for i, row in enumerate(rows):
+            if key not in row:
+                raise KeyError(f"request {i} missing feature {key!r}")
+            value = np.asarray(row[key], dtype=dtype)
+            if value.shape != tuple(shape):
+                raise ValueError(
+                    f"feature {key!r} of request {i} has shape "
+                    f"{value.shape}, expected {tuple(shape)}"
+                )
+            arr[i] = value
+        out[key] = arr
+    return out
+
+
+def split_rows(outputs, n: int) -> List:
+    """The first ``n`` rows of a (possibly pytree) batched output, one
+    entry per real request — the padded tail rows are dropped."""
+    import jax
+
+    return [jax.tree_util.tree_map(lambda a: a[i], outputs)
+            for i in range(n)]

@@ -343,3 +343,173 @@ def test_make_room_dry_run_invariants_random():
             assert s.current + diff[s.name] >= min(s.current, s.min_instance()), (
                 trial, s.name, diff,
             )
+
+
+# -- serving-tier SLO pass -----------------------------------------------------
+
+
+def make_serving_job(name, min_i=1, max_i=6, chips=4, cur=2,
+                     p99=0.25, max_queue=8.0):
+    """A serving-tier job: spec.serving set, same trainer resource shape."""
+    job = TrainingJob.from_dict(
+        {
+            "metadata": {"name": name},
+            "spec": {
+                "tpu": {"chips_per_trainer": chips},
+                "trainer": {
+                    "min_instance": min_i,
+                    "max_instance": max_i,
+                    "resources": {
+                        "requests": {"cpu": "1", "memory": "1Gi"},
+                        "limits": {"cpu": "1", "memory": "1Gi"},
+                    },
+                },
+                "serving": {
+                    "model_dir": "/srv/model",
+                    "buckets": [1, 8, 32],
+                    "slo_p99_seconds": p99,
+                    "max_queue_per_replica": max_queue,
+                },
+            },
+        }
+    )
+    return normalize(job)
+
+
+def breached_signal(queue=50.0):
+    """A ServeSignal whose p99 sits far above any sane SLO."""
+    from edl_tpu.serving.autoscale import ServeSignal
+
+    return ServeSignal(
+        latency_buckets=[(0.1, 0.0), (5.0, 1000.0), (float("inf"), 1000.0)],
+        latency_count=1000.0, queue_depth=queue,
+    )
+
+
+def comfy_signal():
+    from edl_tpu.serving.autoscale import ServeSignal
+
+    return ServeSignal(
+        latency_buckets=[(0.005, 1000.0), (float("inf"), 1000.0)],
+        latency_count=1000.0, queue_depth=0.0,
+    )
+
+
+def serving_scaler(job, cur, n_hosts=4, signal=None):
+    """Autoscaler over a FakeCluster with one serving job at ``cur``
+    replicas and an injected scrape fake."""
+    cluster = FakeCluster(tpu_cluster(n_hosts=n_hosts, chips_per_host=4))
+    cluster.create_role(job.name, "trainer", cur,
+                        job.trainer_request(), job.trainer_limit())
+    scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.01))
+    scaler.on_add(job)
+    scaler._apply_event(scaler._events.get_nowait())
+    scaler.register_serving_endpoints(job.name, ["http://replica:0"])
+    if signal is not None:
+        scaler.serve_scrape = lambda url: signal
+    return scaler, cluster
+
+
+def test_serving_job_grows_on_breached_slo():
+    job = make_serving_job("serve", cur=2)
+    scaler, cluster = serving_scaler(job, cur=2, signal=breached_signal())
+    target = scaler.step()
+    assert target == {"serve": 3}
+    assert cluster.get_trainer_parallelism("serve") == 3
+    assert job.status.scale_history[-1].reason == "serving-slo"
+    # SLO still breached next tick: grows one replica per pass (no jumps)
+    assert scaler.step() == {"serve": 4}
+
+
+def test_serving_job_shrinks_under_comfortable_slo():
+    job = make_serving_job("serve", cur=3)
+    scaler, cluster = serving_scaler(job, cur=3, signal=comfy_signal())
+    assert scaler.step() == {"serve": 2}
+    assert cluster.get_trainer_parallelism("serve") == 2
+
+
+def test_serving_job_holds_without_scrapes():
+    """No signals (all replicas unreachable / resolver empty): hold, never
+    flap blind — and never fall through to the utilization fixed point,
+    which would grow a serving job to fill free chips."""
+    job = make_serving_job("serve", cur=2)
+    scaler, cluster = serving_scaler(job, cur=2, signal=None)
+    scaler.serve_scrape = lambda url: None
+    assert scaler.step() == {}
+    assert cluster.get_trainer_parallelism("serve") == 2
+    # endpoints never registered at all -> same hold
+    scaler._serve_endpoints.clear()
+    assert scaler.step() == {}
+
+
+def test_serving_grow_respects_max_and_node_fit():
+    # at max_instance: breached SLO cannot push past the ceiling
+    job = make_serving_job("serve", max_i=2, cur=2)
+    scaler, cluster = serving_scaler(job, cur=2, signal=breached_signal())
+    assert scaler.step() == {}
+    # chips exhausted: 2-host cluster is full, the grow finds no node
+    job2 = make_serving_job("serve2", cur=2)
+    scaler2, cluster2 = serving_scaler(job2, cur=2, n_hosts=2,
+                                       signal=breached_signal())
+    assert scaler2.step() == {}
+    assert cluster2.get_trainer_parallelism("serve2") == 2
+
+
+def test_serving_shrink_respects_min():
+    job = make_serving_job("serve", min_i=2, cur=2)
+    scaler, cluster = serving_scaler(job, cur=2, signal=comfy_signal())
+    assert scaler.step() == {}
+    assert cluster.get_trainer_parallelism("serve") == 2
+
+
+def test_serving_spend_is_visible_to_training_fixed_point():
+    """Serving grows FIRST and accounts its chips into the snapshot; the
+    training pass then sees one fewer free granule. 5 hosts x 4 chips, 12
+    committed: serving 2->3 takes one of the two free granules, so training
+    goes 1->2 — without the shared accounting it would have seen both free
+    granules and planned 1->3."""
+    cluster = FakeCluster(tpu_cluster(n_hosts=5, chips_per_host=4))
+    serve = make_serving_job("serve", cur=2)
+    train = make_job("train", min_i=1, max_i=10, cur=1).job
+    cluster.create_role("serve", "trainer", 2,
+                        serve.trainer_request(), serve.trainer_limit())
+    cluster.create_role("train", "trainer", 1,
+                        train.trainer_request(), train.trainer_limit())
+    scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.01))
+    scaler.on_add(serve)
+    scaler.on_add(train)
+    for _ in range(2):
+        scaler._apply_event(scaler._events.get_nowait())
+    scaler.register_serving_endpoints("serve", ["http://replica:0"])
+    scaler.serve_scrape = lambda url: breached_signal()
+    target = scaler.step()
+    assert target["serve"] == 3
+    assert target["train"] == 2  # not 3: serving's grow ate a granule
+    assert cluster.get_trainer_parallelism("serve") == 3
+    assert cluster.get_trainer_parallelism("train") == 2
+
+
+def test_make_room_shrinks_serving_above_floor():
+    """A pending training job pulls capacity from a serving job sitting
+    above its floor — serving participates in make-room like any elastic
+    job (shrink-to-admit does not care what a replica computes)."""
+    cluster = FakeCluster(tpu_cluster(n_hosts=4, chips_per_host=4))
+    serve = make_serving_job("serve", min_i=1, max_i=4, cur=4)
+    cluster.create_role("serve", "trainer", 4,
+                        serve.trainer_request(), serve.trainer_limit())
+    newbie = make_job("newbie", min_i=1, max_i=4, cur=1).job
+    cluster.create_role("newbie", "trainer", 1,
+                        newbie.trainer_request(), newbie.trainer_limit())
+    assert all(p.phase == "Pending" for p in cluster.job_pods("newbie"))
+    scaler = Autoscaler(cluster, AutoscalerConfig(loop_seconds=0.01))
+    scaler.on_add(serve)
+    scaler.on_add(newbie)
+    for _ in range(2):
+        scaler._apply_event(scaler._events.get_nowait())
+    # no scrape fake: make-room mode never consults the SLO signal
+    for _ in range(5):
+        scaler.step()
+    assert cluster.get_trainer_parallelism("serve") < 4
+    assert all(p.phase == "Running" for p in cluster.job_pods("newbie"))
+    reasons = {r.reason for r in serve.status.scale_history}
+    assert reasons == {"make-room"}

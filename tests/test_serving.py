@@ -1,0 +1,531 @@
+"""Serving tier: continuous batching, AOT bucket compiles, rolling swap,
+HTTP frontend, SLO signal math, coordinator status publication.
+
+The acceptance contract under test (ISSUE 13): every bucket executable is
+AOT-compiled before the first request — the jit dispatch cache stays
+EMPTY no matter how much traffic flows — and a model-version swap under
+traffic drops no in-flight request.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.models import fit_a_line
+from edl_tpu.obs.metrics import MetricsRegistry, parse_prometheus
+from edl_tpu.runtime.export import _serving_mesh, save_inference_model
+from edl_tpu.serving import (
+    ServeCompileError,
+    ServeOverloadError,
+    ServeSignal,
+    ServingConfig,
+    ServingReplica,
+    ServingSLO,
+    aggregate_signals,
+    desired_replica_delta,
+    histogram_quantile,
+    pad_batch,
+    pick_bucket,
+    plan_chunks,
+    split_rows,
+    validate_buckets,
+)
+from edl_tpu.serving.worker import SERVING_KV_PREFIX
+
+
+def export_fit_a_line(directory, step=100, scale=1.0, versioned=True):
+    model = fit_a_line.MODEL
+    mesh = _serving_mesh(model)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    if scale != 1.0:
+        params = jax.tree_util.tree_map(lambda x: x * scale, params)
+    save_inference_model(directory, "fit_a_line", params, step=step,
+                         versioned=versioned)
+    return params
+
+
+def feature_row(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"x": rng.standard_normal(13).astype(np.float32)}
+
+
+@pytest.fixture
+def replica_factory(tmp_path):
+    """Builds started replicas against a fresh artifact; stops them all."""
+    live = []
+    export_dir = str(tmp_path / "art")
+    export_fit_a_line(export_dir)
+
+    def make(**overrides):
+        kwargs = dict(model_dir=export_dir, buckets=(1, 4, 16),
+                      max_batch_delay_s=0.002, version_poll_s=0.05)
+        kwargs.update(overrides)
+        replica = ServingReplica(ServingConfig(**kwargs),
+                                 registry=MetricsRegistry())
+        live.append(replica)
+        return replica.start()
+
+    make.export_dir = export_dir
+    yield make
+    for replica in live:
+        replica.stop()
+
+
+# -- batcher units -------------------------------------------------------------
+
+
+def test_validate_buckets_rejects_bad_ladders():
+    assert validate_buckets([1, 8, 32]) == (1, 8, 32)
+    with pytest.raises(ValueError):
+        validate_buckets(())
+    with pytest.raises(ValueError):
+        validate_buckets((0, 4))
+    with pytest.raises(ValueError):
+        validate_buckets((4, 4))
+    with pytest.raises(ValueError):
+        validate_buckets((8, 4))
+
+
+def test_pick_bucket_smallest_that_fits():
+    buckets = (1, 8, 32)
+    assert pick_bucket(1, buckets) == 1
+    assert pick_bucket(2, buckets) == 8
+    assert pick_bucket(8, buckets) == 8
+    assert pick_bucket(9, buckets) == 32
+    # above the largest bucket: the dispatcher never coalesces past it,
+    # but pick_bucket itself clamps rather than raising
+    assert pick_bucket(64, buckets) == 32
+
+
+def test_plan_chunks_covers_any_count():
+    # chunk sizes are REQUEST counts (each chunk then pads to its bucket);
+    # the sum always equals n — no request left behind
+    assert plan_chunks(5, (1, 8, 32)) == [5]
+    assert plan_chunks(40, (1, 8, 32)) == [32, 8]
+    assert plan_chunks(70, (1, 8, 32)) == [32, 32, 6]
+    assert plan_chunks(0, (1, 8, 32)) == []
+
+
+def test_pad_batch_zero_pads_and_validates():
+    avals = {"x": ((13,), np.dtype(np.float32))}
+    rows = [feature_row(i) for i in range(3)]
+    batch = pad_batch(rows, 8, avals)
+    assert batch["x"].shape == (8, 13)
+    np.testing.assert_array_equal(batch["x"][3:], 0.0)
+    np.testing.assert_array_equal(batch["x"][0], rows[0]["x"])
+    with pytest.raises(KeyError):
+        pad_batch([{"y": np.zeros(13, np.float32)}], 8, avals)
+    with pytest.raises(ValueError):
+        pad_batch([{"x": np.zeros(7, np.float32)}], 8, avals)
+
+
+def test_split_rows_inverts_padding():
+    outputs = np.arange(16, dtype=np.float32).reshape(8, 2)
+    rows = split_rows(outputs, 3)
+    assert len(rows) == 3
+    np.testing.assert_array_equal(rows[1], outputs[1])
+
+
+# -- replica core --------------------------------------------------------------
+
+
+def test_aot_contract_jit_cache_stays_empty(replica_factory):
+    """THE acceptance criterion: all bucket executables compiled before the
+    first request; serving any amount of traffic leaves the jit dispatch
+    cache at zero entries (Compiled objects are dispatched directly)."""
+    replica = replica_factory()
+    assert replica.jit_cache_size() == 0
+    results = [replica.predict(feature_row(i)) for i in range(10)]
+    futs = [replica.submit(feature_row(i)) for i in range(20)]
+    for f in futs:
+        f.result(timeout=10)
+    assert len(results) == 10
+    assert replica.jit_cache_size() == 0
+    # every bucket was compiled up front (compile gauge set per bucket)
+    text = replica.registry.render_prometheus()
+    for bucket in (1, 4, 16):
+        assert f'edl_serve_compile_seconds{{bucket="{bucket}"}}' in text
+
+
+def test_incompatible_bucket_fails_fast_at_startup(tmp_path):
+    """The flip side of the AOT contract: a bucket the model's sharding
+    can't compile (ctr's shard_map'd lookup needs batch % data-axis == 0,
+    and the serving mesh has data=8) fails `start()` with a serving-level
+    error naming the bucket — never a request-path surprise."""
+    from edl_tpu.models import ctr
+
+    model = ctr.make_model(sparse_dim=512)
+    mesh = _serving_mesh(model)
+    if dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1) == 1:
+        pytest.skip("needs a multi-device data axis to hit divisibility")
+    d = str(tmp_path / "ctrart")
+    save_inference_model(d, "ctr", model.init(jax.random.PRNGKey(0), mesh),
+                         config={"sparse_dim": 512}, step=1, versioned=True)
+    replica = ServingReplica(ServingConfig(model_dir=d, buckets=(1,),
+                                           name="bad-bucket"))
+    with pytest.raises(ServeCompileError, match="bucket 1"):
+        replica.start()
+    replica.stop()
+
+
+def test_predictions_match_direct_model(replica_factory, tmp_path):
+    from edl_tpu.runtime import load_inference_model
+
+    replica = replica_factory()
+    art = load_inference_model(replica_factory.export_dir)
+    rows = [feature_row(i) for i in range(7)]
+    served = [np.asarray(replica.predict(r)) for r in rows]
+    direct = np.asarray(art.predict(
+        {"x": np.stack([r["x"] for r in rows])}
+    ))
+    np.testing.assert_allclose(np.stack(served).ravel(), direct.ravel(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_concurrent_submit_correct_per_request_rows(replica_factory):
+    """64 threads race submit; every caller gets exactly its own row back
+    (the scatter half of batching must not permute results)."""
+    from edl_tpu.runtime import load_inference_model
+
+    replica = replica_factory()
+    art = load_inference_model(replica_factory.export_dir)
+    rows = [feature_row(i) for i in range(64)]
+    expected = np.asarray(art.predict(
+        {"x": np.stack([r["x"] for r in rows])}
+    )).reshape(64, -1)
+    results = [None] * 64
+    errors = []
+
+    def call(i):
+        try:
+            results[i] = np.asarray(replica.predict(rows[i]))
+        except Exception as e:  # pragma: no cover - surfaced via assert
+            errors.append(e)
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    for i in range(64):
+        np.testing.assert_allclose(np.asarray(results[i]).ravel(),
+                                   expected[i].ravel(), rtol=1e-5, atol=1e-6)
+    status = replica.status()
+    assert status["completed"] == 64
+    assert status["errors"] == 0
+    # coalescing actually happened: fewer batches than requests
+    assert sum(status["bucket_hits"].values()) < 64
+
+
+def test_rejects_malformed_features(replica_factory):
+    replica = replica_factory()
+    with pytest.raises(KeyError):
+        replica.submit({"nope": np.zeros(13, np.float32)})
+    with pytest.raises(ValueError):
+        replica.submit({"x": np.zeros(7, np.float32)})
+    with pytest.raises(TypeError):
+        replica.submit([1, 2, 3])
+    # malformed requests are rejected synchronously, before the queue —
+    # they never poison a batch that carries other callers' requests
+    assert replica.predict(feature_row()) is not None
+
+
+def test_overload_rejects_synchronously(tmp_path):
+    export_dir = str(tmp_path / "art")
+    export_fit_a_line(export_dir)
+    replica = ServingReplica(
+        ServingConfig(model_dir=export_dir, buckets=(1,), queue_capacity=2),
+        registry=MetricsRegistry(),
+    )
+    # not started: dispatcher isn't draining, so the queue fills
+    replica._started = True
+    replica._feature_avals = {"x": ((13,), np.dtype(np.float32))}
+    replica.submit(feature_row(0))
+    replica.submit(feature_row(1))
+    with pytest.raises(ServeOverloadError):
+        replica.submit(feature_row(2))
+    assert replica.status()["rejected"] == 1
+
+
+def test_stop_drains_accepted_requests(replica_factory):
+    """The zero-drop half of scale-down: stop(drain=True) serves every
+    already-accepted request before the dispatch thread exits."""
+    replica = replica_factory(max_batch_delay_s=0.0)
+    futs = [replica.submit(feature_row(i)) for i in range(32)]
+    replica.stop(drain=True)
+    for f in futs:
+        assert f.result(timeout=1) is not None  # already resolved
+    assert replica.status()["completed"] == 32
+
+
+def test_stop_without_drain_fails_queued(tmp_path):
+    export_dir = str(tmp_path / "art")
+    export_fit_a_line(export_dir)
+    replica = ServingReplica(
+        ServingConfig(model_dir=export_dir, buckets=(1,), queue_capacity=64),
+        registry=MetricsRegistry(),
+    )
+    replica._started = True
+    replica._feature_avals = {"x": ((13,), np.dtype(np.float32))}
+    futs = [replica.submit(feature_row(i)) for i in range(4)]
+    replica.stop(drain=False)
+    for f in futs:
+        with pytest.raises(RuntimeError):
+            f.result(timeout=1)
+
+
+def test_rolling_swap_under_traffic_drops_nothing(replica_factory):
+    """Publish a new artifact version while requests flow: the watcher
+    swaps params between batches; every in-flight request resolves, and
+    post-swap predictions use the new weights."""
+    replica = replica_factory()
+    stop = threading.Event()
+    failures = []
+    served = [0]
+
+    def traffic():
+        i = 0
+        while not stop.is_set():
+            try:
+                replica.predict(feature_row(i % 8))
+                served[0] += 1
+            except Exception as e:  # pragma: no cover - surfaced via assert
+                failures.append(e)
+                return
+            i += 1
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    time.sleep(0.2)
+    export_fit_a_line(replica_factory.export_dir, step=200, scale=2.0)
+    deadline = time.monotonic() + 10
+    while replica.status()["model_step"] != 200:
+        assert time.monotonic() < deadline, "swap never landed"
+        time.sleep(0.02)
+    time.sleep(0.2)  # keep traffic flowing on the new version
+    stop.set()
+    t.join(timeout=10)
+    assert not failures
+    assert served[0] > 0
+    status = replica.status()
+    assert status["errors"] == 0
+    assert status["swaps"] == 1
+    assert status["last_swap_step"] == 200
+    assert replica.jit_cache_size() == 0  # swap kept the AOT contract
+    # doubled params -> doubled prediction
+    row = feature_row(99)
+    doubled = np.asarray(replica.predict(row))
+    from edl_tpu.runtime import load_inference_model
+
+    art = load_inference_model(replica_factory.export_dir)
+    expected = np.asarray(art.predict({"x": row["x"][None]}))
+    np.testing.assert_allclose(doubled.ravel(), expected.ravel(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stale_version_is_not_reswapped(replica_factory):
+    replica = replica_factory()
+    before = replica.status()
+    time.sleep(0.3)  # several poll periods with nothing new published
+    after = replica.status()
+    assert after["swaps"] == before["swaps"] == 0
+    assert after["version"] == before["version"]
+
+
+# -- HTTP frontend -------------------------------------------------------------
+
+
+def http_post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_predict_single_and_batch(replica_factory):
+    replica = replica_factory(port=0)
+    url = replica.url + "/predict"
+    single = http_post(url, {"features": {"x": feature_row()["x"].tolist()}})
+    assert isinstance(single["outputs"], list)  # one row, unwrapped
+    assert single["model_step"] == 100
+    assert single["version"].startswith("v")
+    rows = [{"x": feature_row(i)["x"].tolist()} for i in range(5)]
+    multi = http_post(url, {"features": rows})
+    assert len(multi["outputs"]) == 5
+
+
+def test_http_error_codes(replica_factory):
+    replica = replica_factory(port=0)
+    url = replica.url + "/predict"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        http_post(url, {"features": {"x": [1.0, 2.0]}})  # bad shape
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        http_post(url, {"nope": 1})
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        http_post(replica.url + "/elsewhere", {"features": {}})
+    assert e.value.code == 404
+
+
+def test_http_metrics_and_health_share_the_port(replica_factory):
+    replica = replica_factory(port=0)
+    http_post(replica.url + "/predict",
+              {"features": {"x": feature_row()["x"].tolist()}})
+    with urllib.request.urlopen(replica.url + "/metrics", timeout=5) as r:
+        families = parse_prometheus(r.read().decode())
+    for family in ("edl_serve_request_latency_seconds",
+                   "edl_serve_queue_depth",
+                   "edl_serve_requests_total",
+                   "edl_serve_batches_total",
+                   "edl_serve_model_step"):
+        assert family in families, family
+    with urllib.request.urlopen(replica.url + "/healthz", timeout=5) as r:
+        health = json.loads(r.read())
+    assert health["completed"] >= 1
+
+
+# -- autoscaler signal math ----------------------------------------------------
+
+
+def test_histogram_quantile_interpolates():
+    buckets = [(0.1, 50.0), (0.5, 90.0), (1.0, 100.0), (float("inf"), 100.0)]
+    assert histogram_quantile(buckets, 0.5) == 0.1
+    # p90 lands exactly at the 0.5 bound
+    assert histogram_quantile(buckets, 0.9) == pytest.approx(0.5)
+    # p95: halfway through the (0.5, 1.0] bucket
+    assert histogram_quantile(buckets, 0.95) == pytest.approx(0.75)
+    assert histogram_quantile([], 0.99) is None
+    assert histogram_quantile([(0.1, 0.0), (float("inf"), 0.0)], 0.5) is None
+    # mass in the +inf bucket clamps to the last finite bound
+    assert histogram_quantile(
+        [(0.1, 0.0), (float("inf"), 10.0)], 0.99
+    ) == pytest.approx(0.1)
+
+
+def sig(p99_bound, count=100.0, queue=0.0):
+    """Signal whose whole mass sits below ``p99_bound``."""
+    return ServeSignal(
+        latency_buckets=[(p99_bound, count), (float("inf"), count)],
+        latency_count=count, queue_depth=queue,
+    )
+
+
+def test_desired_delta_grows_on_breach_and_shrinks_with_hysteresis():
+    slo = ServingSLO(p99_seconds=0.25, max_queue_per_replica=8.0)
+    assert desired_replica_delta([], slo) == 0  # no scrapes: hold
+    assert desired_replica_delta([sig(1.0)], slo) == 1  # p99 breach
+    assert desired_replica_delta([sig(0.01, queue=50.0)], slo) == 1
+    assert desired_replica_delta([sig(0.01, queue=0.0)], slo) == -1
+    # comfortable p99 but queue above the shrink band: hold (hysteresis)
+    assert desired_replica_delta([sig(0.01, queue=4.0)], slo) == 0
+    # p99 in the dead band between shrink and grow thresholds: hold
+    assert desired_replica_delta([sig(0.2)], slo) == 0
+
+
+def test_aggregate_sums_buckets_across_replicas():
+    """One drowning replica must dominate the tier p99, not be averaged
+    away by idle peers."""
+    idle = sig(0.01, count=10.0)
+    drowning = ServeSignal(
+        latency_buckets=[(0.01, 0.0), (5.0, 1000.0), (float("inf"), 1000.0)],
+        latency_count=1000.0, queue_depth=100.0,
+    )
+    p99, queue = aggregate_signals([idle, drowning])
+    assert p99 > 1.0
+    assert queue == pytest.approx(50.0)
+    slo = ServingSLO()
+    assert desired_replica_delta([idle, drowning], slo) == 1
+
+
+def test_scrape_serve_signal_end_to_end(replica_factory):
+    from edl_tpu.serving import scrape_serve_signal
+
+    replica = replica_factory(port=0)
+    for i in range(6):
+        replica.predict(feature_row(i))
+    signal = scrape_serve_signal(replica.url)
+    assert signal is not None
+    assert signal.latency_count >= 6
+    assert signal.latency_buckets[-1][0] == float("inf")
+    # unreachable replica -> None, never an exception
+    assert scrape_serve_signal("http://127.0.0.1:1/metrics") is None
+
+
+# -- coordinator status publication + CLI --------------------------------------
+
+
+def test_replica_publishes_status_to_coordinator_kv(tmp_path):
+    from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+    export_dir = str(tmp_path / "art")
+    export_fit_a_line(export_dir)
+    coord = InProcessCoordinator(heartbeat_ttl_sec=300.0)
+    client = coord.client("serve-a")
+    replica = ServingReplica(
+        ServingConfig(model_dir=export_dir, buckets=(1, 4),
+                      name="serve-a", version_poll_s=0.05,
+                      publish_interval_s=0.0),
+        client=client, registry=MetricsRegistry(),
+    )
+    replica.start()
+    try:
+        replica.predict(feature_row())
+        deadline = time.monotonic() + 5
+        raw = None
+        while time.monotonic() < deadline:
+            raw = client.kv_get(SERVING_KV_PREFIX + "serve-a")
+            if raw and json.loads(raw).get("completed", 0) >= 1:
+                break
+            time.sleep(0.05)
+        status = json.loads(raw)
+        assert status["completed"] >= 1
+        assert status["model_step"] == 100
+        assert "serve-a" in client.members()
+    finally:
+        replica.stop()
+
+
+def test_cli_status_renders_serving_section(tmp_path, capsys):
+    from edl_tpu.cli import main as cli_main
+    from edl_tpu.coordinator.inprocess import InProcessCoordinator
+    from edl_tpu.coordinator.server import CoordinatorServer
+
+    export_dir = str(tmp_path / "art")
+    export_fit_a_line(export_dir)
+    server = CoordinatorServer(port=0)
+    server.start()
+    try:
+        from edl_tpu.coordinator.client import CoordinatorClient
+
+        client = CoordinatorClient("127.0.0.1", server.port, worker="serve-b")
+        replica = ServingReplica(
+            ServingConfig(model_dir=export_dir, buckets=(1,),
+                          name="serve-b", publish_interval_s=0.0),
+            client=client, registry=MetricsRegistry(),
+        )
+        replica.start()
+        try:
+            replica.predict(feature_row())
+            replica._publish_status(force=True)
+            rc = cli_main(["status", "--host", "127.0.0.1",
+                           "--port", str(server.port), "--json"])
+            out = capsys.readouterr().out
+            assert rc == 0
+            payload = json.loads(out)
+            serving = payload.get("serving") or {}
+            assert "serve-b" in serving
+            assert serving["serve-b"]["completed"] >= 1
+        finally:
+            replica.stop()
+    finally:
+        server.stop()
