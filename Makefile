@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-ANALYZE_SCOPE = edl_tpu edl_tpu/serving bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
+ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective bench-serve
+.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -50,6 +50,15 @@ obs-smoke:
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.serving
 
+## Checkpoint-plane deploy gate: trains a twin, replicates ZeRO shards to
+## the coordinator's memory-resident store, kills the live state, peer-
+## restores (zero blob reads) and finishes — final loss must EQUAL the
+## twin's. Then drops a whole replica group and proves recovery demotes to
+## the blob store with the identical result. See doc/robustness.md.
+ckpt-plane-smoke:
+	XLA_FLAGS=--xla_force_host_platform_device_count=4 JAX_PLATFORMS=cpu \
+		$(PYTHON) -m edl_tpu.ckpt_plane
+
 ## Protocol behavior gate: bounded explicit-state exploration of every
 ## interleaving of the default faulty 2-worker schedule (crash+restart,
 ## duplicate delivery, batch frame), each trace replayed against
@@ -77,7 +86,7 @@ tsan-smoke:
 ## protocol_schema.json ratchet), tier-1 tests, protocol model check,
 ## serving smoke, TSan lane. Tier-2 (slow, run before cutting a release):
 ## `make chaos` and `make chaos-composed` — soaks + composed cross-axis run.
-verify: analyze test modelcheck serve-smoke tsan-smoke
+verify: analyze test modelcheck serve-smoke ckpt-plane-smoke tsan-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -19,6 +19,12 @@ collector loop example/fit_a_line/collector.py:215-226):
   separately (``restart_warm_compile_seconds``; the in-process rescale's
   equivalent is ``warm_compile_seconds``) instead of sitting serially
   inside the restore-to-first-step interval.
+- ``restore_arms``: the paired peer-vs-blob restore comparison — the same
+  state restored once from the checkpoint plane (coordinator memory, zero
+  blob reads) and once from orbax, everything warm on both sides. The
+  elastic run itself trains with ``peer_replicas=1``, so the rescale's
+  restore phase in RESCALE_TIMELINE.json carries ``source``/
+  ``bytes_from_peers`` attribution.
 
 Run on the CPU simulation mesh by default (8 virtual devices; CI-stable);
 the same script runs unmodified on real chips. Writes BENCH_RESCALE.json
@@ -116,7 +122,8 @@ def main() -> None:
     half = max(1, full // 2)
     tcfg = TrainerConfig(optimizer="sgd", learning_rate=0.05)
 
-    def run_worker(tag: str, planner, join: bool, tracer=None):
+    def run_worker(tag: str, planner, join: bool, tracer=None,
+                   peer_replicas: int = 0):
         """One full worker run over the identical workload/config; only the
         device plan and the mid-run membership change differ — so retention
         compares elastic-after-rescale against static on the SAME pipeline
@@ -138,7 +145,8 @@ def main() -> None:
                 # flake) — 0.05 s keeps detection well inside the workload.
                 ElasticConfig(checkpoint_dir=os.path.join(workdir, "ck"),
                               checkpoint_interval=50, heartbeat_interval=0.05,
-                              rescale_barrier_timeout=30.0, trainer=tcfg),
+                              rescale_barrier_timeout=30.0, trainer=tcfg,
+                              peer_replicas=peer_replicas),
                 device_planner=planner,
                 profiler=prof,
                 tracer=tracer,
@@ -201,9 +209,13 @@ def main() -> None:
     # One tracer shared by the worker (drain/checkpoint/warm_compile/restore/
     # first_step spans) and the bench's control-plane thread (the actuate
     # span): exactly what a JSONL-stream merge of two pods' sinks would hold.
+    # peer_replicas=1 puts the checkpoint plane in the loop: the rescale's
+    # restore is served from coordinator memory, and the timeline's restore
+    # phase carries source="peer" + bytes_from_peers attribution.
     trace = Tracer(component="bench")
     worker, prof, metrics, workdir = run_worker(
-        "rb", lambda w: devs[: min(full, w * half)], join=True, tracer=trace
+        "rb", lambda w: devs[: min(full, w * half)], join=True, tracer=trace,
+        peer_replicas=1,
     )
 
     assert worker.rescales, "no rescale happened; bench invalid"
@@ -243,6 +255,29 @@ def main() -> None:
     restart_restore_seconds = time.perf_counter() - t0
     restart_warm_compile_seconds = warm_out["seconds"]
 
+    # -- paired restore arms: peer (coordinator memory) vs blob (orbax) -------
+    # Same state, same target mesh/specs, everything warm on both sides —
+    # the isolated restore-path comparison the ft_policy break-even prices.
+    from edl_tpu.ckpt_plane import CkptPlane
+    from edl_tpu.coordinator import InProcessCoordinator
+
+    t0 = time.perf_counter()
+    blob_state = ckpt.restore(abstract_like(fresh), mesh,
+                              live_state_specs(fresh))
+    jax.block_until_ready(jax.tree_util.tree_leaves(blob_state))
+    blob_arm_seconds = time.perf_counter() - t0
+
+    coord = InProcessCoordinator()
+    pclient = coord.client("bench-plane")
+    pclient.register()
+    plane = CkptPlane(pclient, replicas=1)
+    rep = plane.replicate_all(restored, int(restored.step), world=2)
+    assert rep is not None, "bench plane replication failed"
+    t0 = time.perf_counter()
+    peer_state, pinfo = plane.restore(fresh, mesh, live_state_specs(fresh))
+    jax.block_until_ready(jax.tree_util.tree_leaves(peer_state))
+    peer_arm_seconds = time.perf_counter() - t0
+
     result = {
         "max_recovery_seconds": round(max_recovery, 3),
         "retention_vs_static": round(retention, 4),
@@ -253,6 +288,12 @@ def main() -> None:
         ),
         "pass_recovery_under_30s": max_recovery < 30.0,
         "pass_retention_over_90pct": retention >= 0.90,
+        "restore_arms": {
+            "blob_seconds": round(blob_arm_seconds, 4),
+            "peer_seconds": round(peer_arm_seconds, 4),
+            "peer_bytes": int(pinfo["bytes"]),
+            "pass_peer_faster": peer_arm_seconds < blob_arm_seconds,
+        },
         "details": {
             "devices": full,
             "rescale": f"{half}->{full} devices (world 1->2)",
@@ -300,6 +341,7 @@ def main() -> None:
                 "end": round(ph["end"], 6),
                 "component": ph["component"],
                 "count": ph["count"],
+                "attrs": ph.get("attrs", {}),
             }
             for name, ph in breakdown["phases"].items()
         },

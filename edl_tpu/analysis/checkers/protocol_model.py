@@ -74,6 +74,7 @@ class ProtocolModelChecker:
     ) -> Iterator[Finding]:
         from edl_tpu.analysis.modelcheck import (
             ModelCheckError,
+            ckpt_plane_scripts,
             default_scripts,
             explore,
             load_state_effects,
@@ -132,6 +133,20 @@ class ProtocolModelChecker:
                 fuzz_samples=fuzz,
                 fuzz_seed=int(ctx.config.get("edl009_fuzz_seed", 0)),
             )
+            # Checkpoint-plane schedule (shard_put dedup replay, stale put,
+            # step-conditional drop) — explored separately so each schedule
+            # stays inside the interleaving budget; findings merge.
+            extra = explore(
+                ckpt_plane_scripts(),
+                effects,
+                max_traces=int(ctx.config.get("edl009_max_traces", 20000)),
+                max_violations=MAX_VIOLATION_FINDINGS * 4,
+                fuzz_samples=fuzz,
+                fuzz_seed=int(ctx.config.get("edl009_fuzz_seed", 0)),
+            )
+            result.traces += extra.traces
+            result.replays += extra.replays
+            result.violations.extend(extra.violations)
         except ModelCheckError as e:
             yield schema_finding(f"state_effects cannot drive the model: {e}")
             return

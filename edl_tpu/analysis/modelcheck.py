@@ -140,7 +140,7 @@ class ProtocolModel:
 
     _KNOWN_TAGS = {
         "epoch", "lease", "dedup", "kv", "queue", "membership", "parks",
-        "composite",
+        "composite", "shard",
     }
 
     def __init__(self, effects: Dict[str, Dict[str, Any]]):
@@ -163,6 +163,9 @@ class ProtocolModel:
         self.barriers: Dict[str, Dict[str, Any]] = {}
         self.sync_arrived: set = set()
         self.sync_generation = 0
+        # Checkpoint plane: owner -> {step, chunks, nbytes, group, data}.
+        self.shards: Dict[str, Dict[str, Any]] = {}
+        self.shard_put_seen: set = set()
 
     def copy(self) -> "ProtocolModel":
         m = ProtocolModel.__new__(ProtocolModel)
@@ -182,6 +185,13 @@ class ProtocolModel:
         }
         m.sync_arrived = set(self.sync_arrived)
         m.sync_generation = self.sync_generation
+        m.shards = {
+            owner: {"step": b["step"], "chunks": b["chunks"],
+                    "nbytes": b["nbytes"], "group": list(b["group"]),
+                    "data": dict(b["data"])}
+            for owner, b in self.shards.items()
+        }
+        m.shard_put_seen = set(self.shard_put_seen)
         return m
 
     # Every handler returns (reply_prediction | None-if-parked, released)
@@ -359,6 +369,84 @@ class ProtocolModel:
         if marker:
             self.kv[marker] = str(cur)
         return {"ok": True, "value": cur, "epoch": self.epoch}, []
+
+    # Checkpoint-plane ops (memory-resident shard replication). Mirror the
+    # twin's shard_* methods exactly: step supersedes, put_id dedups
+    # exactly-once, drop with a step only removes that exact step. None of
+    # them touch the epoch or park.
+
+    def _op_shard_put(self, worker: str, fields: Dict[str, Any]):
+        owner = fields.get("owner", "")
+        step = int(fields.get("step", -1))
+        chunk = int(fields.get("chunk", -1))
+        chunks = int(fields.get("chunks", 0))
+        if not owner or step < 0 or chunks < 1 or not 0 <= chunk < chunks:
+            return ({"ok": False,
+                     "error": "shard_put requires owner, step>=0, "
+                              "0<=chunk<chunks",
+                     "epoch": self.epoch}, [])
+        put_id = fields.get("put_id")
+        if (put_id and put_id in self.shard_put_seen
+                and self.effects["shard_put"].get("dedup") == "put_id"):
+            return ({"ok": True, "duplicate": True, "stored": True,
+                     "epoch": self.epoch}, [])
+        blob = self.shards.setdefault(
+            owner, {"step": -1, "chunks": 0, "nbytes": 0,
+                    "group": [], "data": {}})
+        if step < blob["step"]:
+            return ({"ok": True, "duplicate": False, "stored": False,
+                     "epoch": self.epoch}, [])
+        if step > blob["step"]:
+            blob["step"] = step
+            blob["data"] = {}
+            blob["group"] = []
+        blob["chunks"] = chunks
+        blob["nbytes"] = int(fields.get("nbytes", 0))
+        group = fields.get("group")
+        if isinstance(group, list):
+            blob["group"] = [str(g) for g in group]
+        blob["data"][chunk] = fields.get("data", "")
+        if put_id:
+            self.shard_put_seen.add(put_id)
+        return ({"ok": True, "duplicate": False, "stored": True,
+                 "epoch": self.epoch}, [])
+
+    def _op_shard_get(self, worker: str, fields: Dict[str, Any]):
+        owner = fields.get("owner", "")
+        step = int(fields.get("step", -1))
+        chunk = int(fields.get("chunk", 0))
+        blob = self.shards.get(owner)
+        if blob is None or (step >= 0 and blob["step"] != step):
+            return ({"ok": True, "found": False, "data": "", "chunks": 0,
+                     "epoch": self.epoch}, [])
+        payload = blob["data"].get(chunk)
+        if payload is None:
+            return ({"ok": True, "found": False, "data": "",
+                     "chunks": blob["chunks"], "epoch": self.epoch}, [])
+        return ({"ok": True, "found": True, "data": payload,
+                 "chunks": blob["chunks"], "epoch": self.epoch}, [])
+
+    def _op_shard_meta(self, worker: str, fields: Dict[str, Any]):
+        blob = self.shards.get(fields.get("owner", ""))
+        if blob is None or blob["step"] < 0:
+            return ({"ok": True, "found": False, "step": -1, "chunks": 0,
+                     "nbytes": 0, "complete": False, "group": [],
+                     "epoch": self.epoch}, [])
+        complete = blob["chunks"] > 0 and len(blob["data"]) == blob["chunks"]
+        return ({"ok": True, "found": True, "step": blob["step"],
+                 "chunks": blob["chunks"], "nbytes": blob["nbytes"],
+                 "complete": complete, "group": list(blob["group"]),
+                 "epoch": self.epoch}, [])
+
+    def _op_shard_drop(self, worker: str, fields: Dict[str, Any]):
+        owner = fields.get("owner", "")
+        step = int(fields.get("step", -1))
+        blob = self.shards.get(owner)
+        dropped = False
+        if blob is not None and (step < 0 or blob["step"] == step):
+            del self.shards[owner]
+            dropped = True
+        return {"ok": True, "dropped": dropped, "epoch": self.epoch}, []
 
     def _op_bump_epoch(self, worker: str, fields: Dict[str, Any]):
         self.epoch += 1
@@ -847,6 +935,38 @@ def default_scripts() -> Dict[str, List[ScriptOp]]:
     return {"w0": w0, "w1": w1}
 
 
+def ckpt_plane_scripts() -> Dict[str, List[ScriptOp]]:
+    """Checkpoint-plane schedule: 2 workers exercising the shard_* ops —
+    a batched two-chunk replication pass, a duplicate shard_put replay
+    (exactly-once under put_id dedup), a stale put racing a newer pass, a
+    chunk fetch, and a step-conditional drop. Kept separate from
+    ``default_scripts`` so the combined interleaving count stays inside the
+    exploration budget (adding 5 ops to the default schedule would blow it)."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("batch", ops=[
+            {"op": "shard_put", "owner": "w0", "step": 1, "chunk": 0,
+             "chunks": 2, "nbytes": 8, "data": "AAAA", "put_id": "w0-p1",
+             "group": ["w1"]},
+            {"op": "shard_put", "owner": "w0", "step": 1, "chunk": 1,
+             "chunks": 2, "nbytes": 8, "data": "BBBB", "put_id": "w0-p2",
+             "group": ["w1"]},
+        ]),
+        mk("shard_put", note="dup", owner="w0", step=1, chunk=0, chunks=2,
+           nbytes=8, data="AAAA", put_id="w0-p1", group=["w1"]),
+        mk("shard_meta", owner="w0"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("shard_put", note="stale", owner="w0", step=0, chunk=0, chunks=1,
+           nbytes=4, data="OLD", put_id="w1-p1"),
+        mk("shard_get", owner="w0", step=-1, chunk=0),
+        mk("shard_drop", owner="w0", step=1),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
 def load_state_effects(root: str, schema_rel: str = "protocol_schema.json"):
     """(state_effects dict or None, declared op set or None, error string)."""
     path = os.path.join(root, schema_rel)
@@ -881,12 +1001,24 @@ def run_default(
         effects, _ops, err = load_state_effects(root)
         if err:
             raise ModelCheckError(err)
-    return explore(
+    result = explore(
         default_scripts(), effects,
         coordinator_factory=coordinator_factory,
         fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
         max_traces=max_traces, max_violations=max_violations,
     )
+    # Second schedule: the checkpoint-plane ops (separate so each schedule's
+    # interleaving count stays inside the budget; results are merged).
+    extra = explore(
+        ckpt_plane_scripts(), effects,
+        coordinator_factory=coordinator_factory,
+        fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
+        max_traces=max_traces, max_violations=max_violations,
+    )
+    result.traces += extra.traces
+    result.replays += extra.replays
+    result.violations.extend(extra.violations)
+    return result
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,50 @@
+"""Memory-resident checkpoint plane: peer-replicated ZeRO-1 shards.
+
+The blob-store ``Checkpointer`` makes state durable; this plane makes the
+common recovery *fast*. Each worker pushes its 1/N ZeRO shard of the train
+state — chunked, epoch-stamped, ``put_id``-deduped — into the coordinator's
+memory-resident shard store (``shard_put``/``shard_get``/``shard_meta``/
+``shard_drop`` on the wire), with a ring replica-placement map published
+through coordinator KV per membership epoch. On worker loss or rescale the
+survivors assemble the full state from the plane in memory and re-shard it
+onto the new mesh — zero blob reads. Only a whole-replica-group death (or
+a coordinator restart: the store is deliberately unjournaled) demotes
+recovery to the blob restore. See doc/robustness.md (checkpoint plane).
+"""
+
+from edl_tpu.ckpt_plane.placement import (
+    PLACEMENT_KEY,
+    placement_map,
+    publish_placement,
+    read_placement,
+    replica_group,
+)
+from edl_tpu.ckpt_plane.recovery import assemble_leaves, peer_restore
+from edl_tpu.ckpt_plane.replicator import (
+    CHUNK_BYTES,
+    CkptPlane,
+    chunk_blob,
+    host_leaves,
+    leaf_slice,
+    owner_key,
+    parse_shard,
+    serialize_shard,
+)
+
+__all__ = [
+    "CkptPlane",
+    "CHUNK_BYTES",
+    "PLACEMENT_KEY",
+    "assemble_leaves",
+    "chunk_blob",
+    "host_leaves",
+    "leaf_slice",
+    "owner_key",
+    "parse_shard",
+    "peer_restore",
+    "placement_map",
+    "publish_placement",
+    "read_placement",
+    "replica_group",
+    "serialize_shard",
+]

@@ -1,0 +1,262 @@
+"""Shard replication: host-level ZeRO-1 slices pushed through the wire.
+
+Each worker owns a 1/``world`` slice of every state leaf — the same
+largest-divisible-dim layout ``parallel.collective.zero_shard_spec`` pins
+device-side (``zero_shard_dim`` picks the dim here too, so the host slice
+IS the ZeRO shard). Leaves no dim of which divides by ``world`` (scalars,
+odd shapes) are owned whole by rank 0. The slices serialize into one blob:
+
+    manifest JSON line  \\n  raw little-endian leaf-slice bytes, leaf order
+
+and the blob rides the coordinator wire base64-encoded in ~256 KB chunks
+(``shard_put`` — epoch-stamped, ``put_id``-deduped, batched through the
+``batch`` frame when the transport supports it). The coordinator's shard
+store is memory-resident and deliberately unjournaled: losing the
+coordinator loses the plane, and recovery falls back to the blob-store
+``Checkpointer`` — the fallback ladder doc/robustness.md describes.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from edl_tpu.ckpt_plane.placement import publish_placement, replica_group
+from edl_tpu.parallel.collective import zero_shard_dim
+
+log = logging.getLogger("edl_tpu.ckpt_plane")
+
+#: wire chunk size BEFORE base64 (the encoded line grows 4/3): large enough
+#: to amortize per-op framing, small enough that one chunk never stalls the
+#: coordinator's single-threaded event loop noticeably.
+CHUNK_BYTES = 256 * 1024
+
+#: owner key prefix in the coordinator shard store; owners are named by the
+#: membership rank that wrote them (``z0``, ``z1``, ...), which is exactly
+#: the identity the manifest's ``world`` lets a restorer re-enumerate.
+OWNER_PREFIX = "z"
+
+
+def owner_key(rank: int, prefix: str = OWNER_PREFIX) -> str:
+    return f"{prefix}{int(rank)}"
+
+
+def leaf_slice(arr: np.ndarray, rank: int, world: int
+               ) -> Tuple[Optional[np.ndarray], Optional[int]]:
+    """``rank``'s ZeRO slice of ``arr`` under ``world``, and the sliced dim.
+
+    Mirrors ``zero_shard_spec``'s placement: the largest dim divisible by
+    ``world`` is split evenly; when none divides (or world==1) the whole
+    leaf belongs to rank 0 and every other rank contributes nothing.
+    """
+    dim = zero_shard_dim(arr.shape, world)
+    if dim is None:
+        return (arr if rank == 0 else None), None
+    per = arr.shape[dim] // world
+    index: List[Any] = [slice(None)] * arr.ndim
+    index[dim] = slice(rank * per, (rank + 1) * per)
+    return np.ascontiguousarray(arr[tuple(index)]), dim
+
+
+def serialize_shard(leaves: List[np.ndarray], step: int, rank: int,
+                    world: int) -> bytes:
+    """One rank's shard blob: manifest line + concatenated slice bytes."""
+    metas: List[Dict] = []
+    payload: List[bytes] = []
+    for arr in leaves:
+        arr = np.asarray(arr)
+        piece, dim = leaf_slice(arr, rank, world)
+        raw = piece.tobytes() if piece is not None else b""
+        metas.append({
+            "shape": list(arr.shape),
+            "dtype": arr.dtype.str,
+            "dim": dim,
+            "nbytes": len(raw),
+        })
+        payload.append(raw)
+    manifest = {
+        "v": 1,
+        "step": int(step),
+        "rank": int(rank),
+        "world": int(world),
+        "leaves": metas,
+    }
+    return json.dumps(manifest).encode() + b"\n" + b"".join(payload)
+
+
+def parse_shard(blob: bytes) -> Tuple[Dict, bytes]:
+    """Split a shard blob back into (manifest, payload bytes)."""
+    head, sep, payload = blob.partition(b"\n")
+    if not sep:
+        raise ValueError("shard blob has no manifest line")
+    return json.loads(head.decode()), payload
+
+
+def chunk_blob(blob: bytes, chunk_bytes: int = CHUNK_BYTES) -> List[str]:
+    """Base64-encoded wire chunks (at least one, even for an empty blob)."""
+    chunks = [
+        base64.b64encode(blob[i:i + chunk_bytes]).decode("ascii")
+        for i in range(0, len(blob), chunk_bytes)
+    ] or [base64.b64encode(b"").decode("ascii")]
+    return chunks
+
+
+def host_leaves(state: Any) -> Tuple[List[np.ndarray], Any]:
+    """Flatten ``state`` to host numpy leaves + its treedef. Works on live
+    (device-placed, possibly sharded) pytrees: single-controller arrays are
+    fully addressable, so ``device_get`` materializes the global value."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    return [np.asarray(jax.device_get(x)) for x in leaves], treedef
+
+
+class CkptPlane:
+    """One worker's handle on the memory-resident checkpoint plane.
+
+    Everything here is best-effort by design: replication that fails (the
+    coordinator is down, mid-restart, out of memory) logs and returns
+    None — the blob-store checkpoint the caller just wrote is the durable
+    copy; the plane only makes recovery *faster*, never *possible*.
+    """
+
+    def __init__(self, client, replicas: int = 1,
+                 owner_prefix: str = OWNER_PREFIX,
+                 chunk_bytes: int = CHUNK_BYTES,
+                 instruments=None, tracer=None):
+        if replicas < 1:
+            raise ValueError(f"CkptPlane needs replicas >= 1, got {replicas}")
+        # Plane traffic goes to the RAW transport, not an OutboxClient
+        # facade: buffering multi-MB shard chunks for outage replay would
+        # turn the outbox into a second (worse) checkpoint store.
+        self.client = getattr(client, "client", client)
+        self.replicas = int(replicas)
+        self.owner_prefix = owner_prefix
+        self.chunk_bytes = int(chunk_bytes)
+        if instruments is None:
+            from edl_tpu.obs.instruments import CkptPlaneInstruments
+
+            instruments = CkptPlaneInstruments()
+        self.obs = instruments
+        self.tracer = tracer
+        #: last epoch whose placement map this worker published (the key
+        #: ``on_epoch`` invalidates when the epoch moves on).
+        self._published_epoch: Optional[int] = None
+
+    # -- placement lifecycle ---------------------------------------------------
+
+    def on_epoch(self, epoch: int, world: int, rank: int) -> None:
+        """Membership epoch adopted: publish the new placement map and
+        invalidate the previous epoch's. Idempotent and best-effort."""
+        try:
+            publish_placement(self.client, epoch, world, self.replicas,
+                              prev_epoch=self._published_epoch)
+            self._published_epoch = int(epoch)
+        except Exception:  # edl: noqa[EDL005] placement publish is advisory metadata; losing it degrades to manifest-derived discovery, never to data loss
+            log.debug("ckpt-plane placement publish failed", exc_info=True)
+
+    # -- replication -----------------------------------------------------------
+
+    def replicate(self, state: Any, step: int, rank: int,
+                  world: int) -> Optional[Dict]:
+        """Push this rank's ZeRO slice of ``state`` at ``step`` to the
+        plane (the multi-controller path: each process owns one slice).
+        Returns {bytes, chunks, seconds} or None on failure."""
+        return self._replicate_ranks(state, step, [rank], world)
+
+    def replicate_all(self, state: Any, step: int,
+                      world: int) -> Optional[Dict]:
+        """Push EVERY rank's slice from one process — the single-controller
+        path (``ElasticWorker``'s mesh is fully addressable, so one host
+        gather serves all ``world`` shards). The plane still stores them as
+        ``world`` independent owners: recovery and the group-death fallback
+        behave identically to the per-process layout."""
+        return self._replicate_ranks(state, step, list(range(world)), world)
+
+    def _replicate_ranks(self, state: Any, step: int, ranks: List[int],
+                         world: int) -> Optional[Dict]:
+        t0 = time.perf_counter()
+        t0_wall = time.time()  # spans stitch on the wall clock
+        total = 0
+        chunk_count = 0
+        try:
+            leaves, _ = host_leaves(state)
+            for rank in ranks:
+                blob = serialize_shard(leaves, step, rank, world)
+                chunks = chunk_blob(blob, self.chunk_bytes)
+                group = [owner_key(h, self.owner_prefix)
+                         for h in replica_group(rank, world, self.replicas)]
+                self._put_chunks(owner_key(rank, self.owner_prefix), step,
+                                 chunks, len(blob), group)
+                total += len(blob)
+                chunk_count += len(chunks)
+        except Exception:  # edl: noqa[EDL005] replication is the fast path on top of a durable blob save; any transport/serialization failure must degrade, not propagate
+            log.warning("ckpt-plane replicate failed at step %s; blob "
+                        "checkpoint remains the restore source", step,
+                        exc_info=True)
+            return None
+        seconds = time.perf_counter() - t0
+        self.obs.replicated_bytes.inc(float(total))
+        self.obs.replications.inc()
+        self.obs.replication_lag.set(seconds)
+        if self.tracer is not None:
+            self.tracer.record("peer_replicate", t0_wall, time.time(),
+                               component="worker", step=int(step),
+                               bytes=total, chunks=chunk_count)
+        return {"bytes": total, "chunks": chunk_count, "seconds": seconds}
+
+    def _put_chunks(self, owner: str, step: int, chunks: List[str],
+                    nbytes: int, group: List[str]) -> None:
+        """Wire the chunks, batched through one ``batch`` frame per window
+        when the transport supports it (one round trip, positional
+        replies), else one ``shard_put`` per chunk."""
+        call_batch = getattr(self.client, "call_batch", None)
+        total = len(chunks)
+        if callable(call_batch):
+            window = 8  # keep each batch frame's line well under a few MB
+            for base in range(0, total, window):
+                ops = []
+                for i, data in enumerate(chunks[base:base + window]):
+                    chunk = base + i
+                    ops.append({
+                        "op": "shard_put", "owner": owner, "step": int(step),
+                        "chunk": chunk, "chunks": total, "nbytes": int(nbytes),
+                        "data": data, "group": group,
+                        "put_id": f"{owner}.s{step}.c{chunk}",
+                    })
+                for sub in call_batch(ops):
+                    if not sub.get("ok"):
+                        raise RuntimeError(f"shard_put rejected: {sub}")
+        else:
+            for chunk, data in enumerate(chunks):
+                reply = self.client.shard_put(
+                    owner, int(step), chunk, total, data,
+                    nbytes=int(nbytes), group=group,
+                    put_id=f"{owner}.s{step}.c{chunk}",
+                )
+                if not reply.get("ok"):
+                    raise RuntimeError(f"shard_put rejected: {reply}")
+
+    # -- recovery (delegates to ckpt_plane.recovery) ---------------------------
+
+    def restore(self, template: Any, mesh=None, spec_tree=None,
+                min_step: Optional[int] = None) -> Optional[Tuple[Any, Dict]]:
+        """Assemble the full state from the plane; see ``recovery.peer_restore``."""
+        from edl_tpu.ckpt_plane.recovery import peer_restore
+
+        return peer_restore(self.client, template, mesh=mesh,
+                            spec_tree=spec_tree, min_step=min_step,
+                            owner_prefix=self.owner_prefix,
+                            instruments=self.obs, tracer=self.tracer)
+
+    # -- admin / test surface --------------------------------------------------
+
+    def drop_owner(self, rank: int, step: int = -1) -> None:
+        """Forget one owner's shard (chaos harness: a replica-group death is
+        every member's drop)."""
+        self.client.shard_drop(owner_key(rank, self.owner_prefix), step)

@@ -150,6 +150,7 @@ class CoordinatorClient:
         self._nonce = uuid.uuid4().hex[:8]
         self._acquire_seq = 0
         self._op_seq = 0
+        self._put_seq = 0
         #: serializes one full request/reply transaction per call() — the
         #: socket and _buf pair replies to requests by ordering, so
         #: interleaved sends from two threads would cross-deliver replies.
@@ -483,6 +484,51 @@ class CoordinatorClient:
         if not reply.get("ok"):
             raise CoordinatorError(f"kv_incr failed: {reply.get('error')}")
         return int(reply["value"])
+
+    # -- checkpoint plane (memory-resident shard replication) ------------------
+
+    def shard_put(self, owner: str, step: int, chunk: int, chunks: int,
+                  data: str, nbytes: int = 0,
+                  group: Optional[List[str]] = None,
+                  put_id: Optional[str] = None) -> Dict:
+        """Replicate one chunk of ``owner``'s ZeRO-1 shard into the plane.
+
+        Each put carries a per-connection ``put_id`` so a retried put (lost
+        reply, outbox replay) applies exactly once — the server acks the
+        replay with ``duplicate`` instead of re-storing. The plane keeps
+        only the latest ``step`` per owner; a stale put acks with
+        ``stored: False`` and the replicator moves on.
+        """
+        if put_id is None:
+            put_id = self._next_put_id()
+        fields: Dict = {"owner": owner, "step": int(step),
+                        "chunk": int(chunk), "chunks": int(chunks),
+                        "nbytes": int(nbytes), "data": data,
+                        "put_id": put_id}
+        if group is not None:
+            fields["group"] = list(group)
+        return self.call("shard_put", **fields)
+
+    def shard_get(self, owner: str, step: int = -1, chunk: int = 0) -> Dict:
+        """Fetch one chunk of a (possibly dead) owner's replicated shard;
+        ``step < 0`` means latest, a specific step must match exactly."""
+        return self.call("shard_get", owner=owner, step=int(step),
+                         chunk=int(chunk))
+
+    def shard_meta(self, owner: str) -> Dict:
+        """What the plane holds for ``owner``: {found, step, chunks, nbytes,
+        complete, group} — ``complete`` is the restorer's go/no-go."""
+        return self.call("shard_meta", owner=owner)
+
+    def shard_drop(self, owner: str, step: int = -1) -> Dict:
+        """Invalidate ``owner``'s replicated shard (``step < 0``:
+        unconditionally; else only that exact step)."""
+        return self.call("shard_drop", owner=owner, step=int(step))
+
+    def _next_put_id(self) -> str:
+        with self._lock:
+            self._put_seq += 1
+            return f"{self._nonce}.p{self._put_seq}"
 
     def status(self) -> Dict:
         return self.call("status")

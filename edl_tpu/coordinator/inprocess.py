@@ -14,6 +14,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+import uuid
 from collections import deque
 from typing import Dict, List, Optional, Set
 
@@ -44,6 +45,15 @@ class InProcessCoordinator:
         self._sync_arrived: Set[str] = set()
         self._sync_generation = 0
         self._kv: Dict[str, str] = {}
+        # Memory-resident checkpoint plane (native parity: op_shard_*).
+        # owner -> {step, chunks, nbytes, group, data: {chunk: payload}}.
+        # Volatile by design (the native store is not journaled either):
+        # the blob-store checkpoint stays the durable tier, and member drop
+        # does NOT clear an owner's blob — surviving a dead owner is the
+        # whole point of the plane.
+        self._shards: Dict[str, Dict] = {}
+        self._shard_put_seen: Set[str] = set()
+        self._shard_put_order: deque = deque()
         # Test-only mutation hook: EDL009's model checker flips this on a
         # deliberately-broken twin to prove a dedup regression is caught.
         # Never set outside tests.
@@ -359,6 +369,93 @@ class InProcessCoordinator:
                 self._kv[marker] = str(cur)
             return {"ok": True, "value": cur}
 
+    #: put_id dedup markers kept (FIFO) before the oldest is forgotten —
+    #: mirrors the native kShardPutSeenCap.
+    SHARD_PUT_SEEN_CAP = 4096
+
+    def shard_put(self, owner: str, step: int, chunk: int, chunks: int,
+                  nbytes: int = 0, data: str = "",
+                  put_id: Optional[str] = None,
+                  group: Optional[List[str]] = None) -> Dict:
+        """Checkpoint-plane replication (native op_shard_put): store one
+        chunk of an owner's ZeRO-1 shard; latest step supersedes; ``put_id``
+        dedups replayed puts exactly-once (marked seen only after a
+        successful apply, so duplicate implies the original landed)."""
+        with self._lock:
+            if not owner or step < 0 or chunks < 1 or not 0 <= chunk < chunks:
+                return {"ok": False,
+                        "error": "shard_put requires owner, step>=0, "
+                                 "0<=chunk<chunks"}
+            if put_id and put_id in self._shard_put_seen \
+                    and not self._test_disable_dedup:
+                return {"ok": True, "duplicate": True, "stored": True}
+            blob = self._shards.setdefault(
+                owner, {"step": -1, "chunks": 0, "nbytes": 0,
+                        "group": [], "data": {}})
+            if step < blob["step"]:
+                # Stale chunk racing a newer replication pass: not stored,
+                # not an error.
+                return {"ok": True, "duplicate": False, "stored": False}
+            if step > blob["step"]:
+                blob["step"] = step
+                blob["data"] = {}
+                blob["group"] = []
+            blob["chunks"] = int(chunks)
+            blob["nbytes"] = int(nbytes)
+            if isinstance(group, list):
+                blob["group"] = [str(g) for g in group]
+            blob["data"][int(chunk)] = data
+            if put_id:
+                self._shard_put_seen.add(put_id)
+                self._shard_put_order.append(put_id)
+                if len(self._shard_put_order) > self.SHARD_PUT_SEEN_CAP:
+                    self._shard_put_seen.discard(
+                        self._shard_put_order.popleft())
+            return {"ok": True, "duplicate": False, "stored": True}
+
+    def shard_get(self, owner: str, step: int = -1, chunk: int = 0) -> Dict:
+        """Recovery fetch (native op_shard_get): one chunk of a possibly-dead
+        owner's replicated shard. step<0 means latest; a specific step must
+        match exactly so a restorer never mixes replication passes."""
+        with self._lock:
+            blob = self._shards.get(owner)
+            if blob is None or (step >= 0 and blob["step"] != step):
+                return {"ok": True, "found": False, "data": "", "chunks": 0}
+            payload = blob["data"].get(int(chunk))
+            if payload is None:
+                return {"ok": True, "found": False, "data": "",
+                        "chunks": int(blob["chunks"])}
+            return {"ok": True, "found": True, "data": payload,
+                    "chunks": int(blob["chunks"])}
+
+    def shard_meta(self, owner: str) -> Dict:
+        """Plane inventory for one owner (native op_shard_meta):
+        complete=True only when every chunk of the latest step is present —
+        the restorer's go/no-go before pulling chunks."""
+        with self._lock:
+            blob = self._shards.get(owner)
+            if blob is None or blob["step"] < 0:
+                return {"ok": True, "found": False, "step": -1, "chunks": 0,
+                        "nbytes": 0, "complete": False, "group": []}
+            complete = blob["chunks"] > 0 \
+                and len(blob["data"]) == blob["chunks"]
+            return {"ok": True, "found": True, "step": int(blob["step"]),
+                    "chunks": int(blob["chunks"]),
+                    "nbytes": int(blob["nbytes"]), "complete": complete,
+                    "group": list(blob["group"])}
+
+    def shard_drop(self, owner: str, step: int = -1) -> Dict:
+        """Epoch/placement invalidation (native op_shard_drop): step<0 drops
+        unconditionally; step>=0 only if the plane holds exactly that step,
+        so a drop racing a newer put cannot destroy the newer blob."""
+        with self._lock:
+            blob = self._shards.get(owner)
+            dropped = False
+            if blob is not None and (step < 0 or blob["step"] == step):
+                del self._shards[owner]
+                dropped = True
+            return {"ok": True, "dropped": dropped}
+
     def status(self) -> Dict:
         with self._lock:
             self._tick()
@@ -450,6 +547,10 @@ class InProcessClient:
         self.last_membership_at: float = 0.0
         self.piggyback_heartbeat: float = 0.0
         self.retry_count = 0
+        #: per-client nonce for shard_put dedup ids (CoordinatorClient
+        #: parity: a fresh client can never replay a predecessor's markers).
+        self._nonce = uuid.uuid4().hex[:8]
+        self._put_seq = 0
 
     def _auth(self) -> None:
         self._c.authorize(self.token)
@@ -542,6 +643,35 @@ class InProcessClient:
         self._auth()
         return self._c.kv_incr(key, delta)
 
+    # -- checkpoint plane ------------------------------------------------------
+
+    def shard_put(self, owner, step, chunk, chunks, data, nbytes=0,
+                  group=None, put_id=None):
+        """Convenience mirror of CoordinatorClient.shard_put: auto-generates
+        a per-client put_id when none is given, so bare retries dedup."""
+        self._auth()
+        if put_id is None:
+            put_id = self._next_put_id()
+        return self._c.shard_put(owner, int(step), int(chunk), int(chunks),
+                                 nbytes=int(nbytes), data=data,
+                                 put_id=put_id, group=group)
+
+    def shard_get(self, owner, step=-1, chunk=0):
+        self._auth()
+        return self._c.shard_get(owner, int(step), int(chunk))
+
+    def shard_meta(self, owner):
+        self._auth()
+        return self._c.shard_meta(owner)
+
+    def shard_drop(self, owner, step=-1):
+        self._auth()
+        return self._c.shard_drop(owner, int(step))
+
+    def _next_put_id(self):
+        self._put_seq += 1
+        return f"{self._nonce}.p{self._put_seq}"
+
     def _stamp(self, reply):
         """Mirror of the native handle()'s stamp_epoch: every reply carries
         the membership epoch, so clients coalesce epoch observation off any
@@ -579,6 +709,22 @@ class InProcessClient:
             return self._stamp(self._c.kv_incr_reply(
                 fields.get("key", ""), fields.get("delta", 1),
                 op_id=fields.get("op_id")))
+        if op == "shard_put":
+            return self._stamp(self._c.shard_put(
+                fields.get("owner", ""), int(fields.get("step", -1)),
+                int(fields.get("chunk", -1)), int(fields.get("chunks", 0)),
+                nbytes=int(fields.get("nbytes", 0)),
+                data=fields.get("data", ""),
+                put_id=fields.get("put_id"), group=fields.get("group")))
+        if op == "shard_get":
+            return self._stamp(self._c.shard_get(
+                fields.get("owner", ""), int(fields.get("step", -1)),
+                int(fields.get("chunk", 0))))
+        if op == "shard_meta":
+            return self._stamp(self._c.shard_meta(fields.get("owner", "")))
+        if op == "shard_drop":
+            return self._stamp(self._c.shard_drop(
+                fields.get("owner", ""), int(fields.get("step", -1))))
         if op == "kv_get":
             return self._stamp(
                 {"ok": True, "value": self._c.kv_get(fields["key"])})

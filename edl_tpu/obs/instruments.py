@@ -15,7 +15,7 @@ from typing import Optional
 from edl_tpu.obs.metrics import MetricsRegistry, get_registry
 
 __all__ = ["WorkerInstruments", "FTPolicyInstruments", "ServeInstruments",
-           "OUTAGE_BUCKETS", "SERVE_LATENCY_BUCKETS"]
+           "CkptPlaneInstruments", "OUTAGE_BUCKETS", "SERVE_LATENCY_BUCKETS"]
 
 #: outage-duration buckets: sub-second blips through multi-minute storms.
 #: The default latency buckets top out at 60 s — exactly where the park
@@ -183,6 +183,41 @@ class ServeInstruments:
         )
 
 
+class CkptPlaneInstruments:
+    """The memory-resident checkpoint plane's sensor suite: how far behind
+    the durable checkpoint the peer replicas run, how many bytes ride the
+    wire, and — the fallback-ladder audit — which source each restore was
+    served from (peer memory vs blob store)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.replication_lag = r.gauge(
+            "edl_ckpt_plane_replication_lag_seconds",
+            "seconds the last shard replication took end-to-end (host "
+            "gather + serialize + wire); the window in which a worker loss "
+            "would find the plane one step stale",
+        )
+        self.replicated_bytes = r.counter(
+            "edl_ckpt_plane_replicated_bytes_total",
+            "shard bytes pushed to the coordinator's memory-resident store",
+        )
+        self.replications = r.counter(
+            "edl_ckpt_plane_replications_total",
+            "shard replications completed (one per covered checkpoint)",
+        )
+        self.restores = r.counter(
+            "edl_ckpt_plane_restores_total",
+            "state restores by source: 'peer' = assembled from the plane "
+            "in memory, 'blob' = fell back to the durable Checkpointer",
+            labelnames=("source",),
+        )
+        self.restore_bytes = r.counter(
+            "edl_ckpt_plane_restore_bytes_total",
+            "restore bytes served, by source (peer vs blob)",
+            labelnames=("source",),
+        )
+
+
 class FTPolicyInstruments:
     """The fault-tolerance policy engine's audit surface: which mode was
     chosen, how often, and the live inputs the choice was computed from.
@@ -226,4 +261,11 @@ class FTPolicyInstruments:
             "edl_ft_policy_failure_rate_per_min",
             "closed incidents per minute over the trailing window "
             "(storm detector input)",
+        )
+        self.restore_cost = r.gauge(
+            "edl_ft_policy_restore_cost_seconds",
+            "EMA of measured restore cost by source (peer = checkpoint "
+            "plane, blob = durable store); the break-even the policy's "
+            "restore_source() and park pricing read",
+            labelnames=("source",),
         )

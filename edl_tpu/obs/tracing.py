@@ -206,7 +206,7 @@ def rescale_timeline(spans: Iterable[Union[Span, dict]],
     Returns ``{trace_id: {"phases": {name: {...}}, "components": [...],
     "wall_seconds": ..., "span_count": n}}``. A phase recorded more than
     once under one trace (both sides timing "restore") keeps the longest
-    observation and counts the repeats. ``wall_seconds`` is last end minus
+    observation — its ``attrs`` ride along — and counts the repeats. ``wall_seconds`` is last end minus
     first start across the whole trace — the number recovery budgets are
     written against; per-phase seconds attribute it (phases may overlap:
     warm_compile runs concurrent with restore by design, so the sum of
@@ -233,6 +233,7 @@ def rescale_timeline(spans: Iterable[Union[Span, dict]],
                     "start": d.get("start", 0.0),
                     "end": d.get("end", 0.0),
                     "component": d.get("component", ""),
+                    "attrs": dict(d.get("attrs") or {}),
                     "count": 1,
                 }
             else:
@@ -240,7 +241,8 @@ def rescale_timeline(spans: Iterable[Union[Span, dict]],
                 if seconds > cur["seconds"]:
                     cur.update(seconds=seconds, start=d.get("start", 0.0),
                                end=d.get("end", 0.0),
-                               component=d.get("component", ""))
+                               component=d.get("component", ""),
+                               attrs=dict(d.get("attrs") or {}))
         starts = [d.get("start", 0.0) for d in recs]
         ends = [d.get("end", 0.0) for d in recs]
         out[tid] = {

@@ -106,20 +106,35 @@ class Checkpointer:
         ``abstract_state`` is a ShapeDtypeStruct pytree (e.g. from
         ``jax.eval_shape`` of the init path on the NEW mesh) — shapes must
         match what was saved; shardings may differ freely.
+
+        With ``step=None`` an unreadable latest step (torn write: the pod
+        died mid-upload and left a truncated directory) falls back to the
+        next-newest step rather than failing recovery — a stale-but-valid
+        restore point beats none. An EXPLICIT ``step`` keeps exact-step
+        semantics: corruption there propagates to the caller.
         """
-        step = step if step is not None else self._mngr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint in {self.directory}")
         shardings = state_shardings(abstract_state, mesh, spec_tree)
         target = jax.tree_util.tree_map(
             lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
             abstract_state,
             shardings,
         )
-        restored = self._mngr.restore(
-            step, args=ocp.args.Composite(state=ocp.args.StandardRestore(target))
-        )
-        return restored["state"]
+        args = ocp.args.Composite(state=ocp.args.StandardRestore(target))
+        if step is not None:
+            return self._mngr.restore(step, args=args)["state"]
+        steps = sorted(self._mngr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        for i, candidate in enumerate(steps):
+            try:
+                return self._mngr.restore(candidate, args=args)["state"]
+            except Exception as e:  # edl: noqa[EDL005] orbax surfaces torn/truncated step dirs as a zoo of exception types; anything unreadable demotes to the previous step
+                if i == len(steps) - 1:
+                    raise
+                log.warning(
+                    "checkpoint step %s is unreadable (%s); falling back to "
+                    "previous step %s", candidate, e, steps[i + 1]
+                )
 
     def restore_extra(self, step: Optional[int] = None) -> Optional[dict]:
         step = step if step is not None else self._mngr.latest_step()

@@ -113,6 +113,13 @@ class ElasticConfig:
     #: endpoint also bridges the coordinator's status counters, so one
     #: scrape of any worker sees control plane and data plane together.
     metrics_port: Optional[int] = None
+    #: memory-resident checkpoint plane (``edl_tpu.ckpt_plane``): > 0
+    #: replicates each worker's ZeRO-1 state shard to this many ring peers
+    #: through the coordinator at every checkpoint, and restores assemble
+    #: from peers in memory (zero blob reads) with the blob store as the
+    #: group-death fallback. 0 (the default) disables the plane entirely —
+    #: restores read the blob store exactly as before.
+    peer_replicas: int = 0
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
     def __post_init__(self) -> None:
@@ -148,6 +155,11 @@ class ElasticConfig:
             raise ValueError(
                 f"ElasticConfig.policy must be 'adaptive' or 'static', "
                 f"got {self.policy!r}")
+        if self.peer_replicas < 0:
+            raise ValueError(
+                f"ElasticConfig.peer_replicas must be >= 0 "
+                f"(0 disables the checkpoint plane), got "
+                f"{self.peer_replicas!r}")
 
 
 def default_device_planner(chips_per_trainer: int) -> Callable[[int], Sequence[jax.Device]]:
@@ -238,6 +250,20 @@ class ElasticWorker:
         self._default_retry = None
         self.client.on_outage_close = self._on_outage_close
         self.ckpt = Checkpointer(config.checkpoint_dir)
+        #: memory-resident checkpoint plane (None when disabled): peer-
+        #: replicated ZeRO shards pushed at every checkpoint, assembled in
+        #: memory on restore, blob store as the group-death fallback.
+        if config.peer_replicas > 0:
+            from edl_tpu.ckpt_plane import CkptPlane
+
+            self.ckpt_plane: Optional[CkptPlane] = CkptPlane(
+                self.client, replicas=config.peer_replicas,
+                tracer=self.tracer)
+        else:
+            self.ckpt_plane = None
+        #: what the last _restore_or_init was served from — the restore
+        #: span's source/bytes attribution (peer | blob | init).
+        self._last_restore: Dict = {"source": "init", "bytes": 0}
         self.rescales: List[RescaleEvent] = []
         self.steps_done = 0
         self.losses: List[float] = []
@@ -330,6 +356,10 @@ class ElasticWorker:
         self._world = max(1, info["world"])
         self._rank = int(info.get("rank", -1))
         self.obs.note_epoch(self._epoch)
+        if self.ckpt_plane is not None:
+            # New epoch = new rank numbering: publish the epoch's replica-
+            # placement map and invalidate the previous epoch's key.
+            self.ckpt_plane.on_epoch(self._epoch, self._world, self._rank)
 
     def _sync_membership(self) -> None:
         # run() entry = incarnation boundary: a predecessor's leases (same
@@ -527,11 +557,41 @@ class ElasticWorker:
     ) -> TrainState:
         if fresh is None:
             fresh = trainer.init_state()
-        if self.ckpt.latest_step() is None:
+        self._last_restore = {"source": "init", "bytes": 0}
+        blob_step = self.ckpt.latest_step()
+        if (self.ckpt_plane is not None
+                and self.policy.restore_source() == "peer"):
+            # Peer-first (the break-even above may demote to blob-first):
+            # assemble the state from the coordinator's memory-resident
+            # shards, re-sharded onto THIS mesh through the same spec
+            # machinery orbax uses. min_step pins the plane to at least the
+            # blob store's best — recovery never moves training backwards.
+            t0 = time.time()
+            got = self.ckpt_plane.restore(
+                fresh, trainer.mesh, live_state_specs(fresh),
+                min_step=blob_step,
+            )
+            if got is not None:
+                state, info = got
+                self.policy.note_peer_restore(time.time() - t0)
+                self._last_restore = {"source": "peer",
+                                      "bytes": int(info["bytes"])}
+                log.info(
+                    "restored step=%s from %d peer shard(s) onto %d-device "
+                    "mesh (%d bytes in memory, zero blob reads)",
+                    info["step"], info["world_at_save"], trainer.mesh.size,
+                    info["bytes"])
+                return state
+        if blob_step is None:
             return fresh
         state = self.ckpt.restore(
             abstract_like(fresh), trainer.mesh, live_state_specs(fresh)
         )
+        self._last_restore = {"source": "blob", "bytes": 0}
+        if self.ckpt_plane is not None:
+            # The fallback rung actually taken — the restores-by-source
+            # audit is what proves a group death demoted cleanly.
+            self.ckpt_plane.obs.restores.inc(source="blob")
         log.info("restored checkpoint step=%s onto %d-device mesh",
                  self.ckpt.latest_step(), trainer.mesh.size)
         return state
@@ -623,6 +683,12 @@ class ElasticWorker:
         self.ckpt.save(int(state.step), state)
         if block:
             self.ckpt.wait()
+        if self.ckpt_plane is not None:
+            # Single-controller: this process addresses the whole mesh, so
+            # one host gather covers every rank's shard. Best-effort — the
+            # blob save above is the durable copy.
+            self.ckpt_plane.replicate_all(
+                state, int(state.step), max(1, self._world))
 
     def _checkpoint_and_commit(
         self, state: TrainState, reader: Optional[LeaseReader], block: bool
@@ -744,9 +810,18 @@ class ElasticWorker:
             join_warm = self._start_warm_compile(trainer, fresh, trace_id=rid)
             t_restore0 = time.time()
             state = self._restore_or_init(trainer, fresh=fresh)
-            self.tracer.record("restore", t_restore0, time.time(),
-                               trace_id=rid, component="worker", world=world)
-            self.policy.note_restore_cost(time.time() - t_restore0)
+            self.tracer.record(
+                "restore", t_restore0, time.time(), trace_id=rid,
+                component="worker", world=world,
+                source=self._last_restore["source"],
+                bytes_from_peers=(self._last_restore["bytes"]
+                                  if self._last_restore["source"] == "peer"
+                                  else 0),
+            )
+            if self._last_restore["source"] != "peer":
+                # Peer restores feed their own EMA (note_peer_restore); only
+                # a blob/init-path restore prices the blob arm.
+                self.policy.note_restore_cost(time.time() - t_restore0)
             compile_seconds = join_warm()
             # first_step measures mesh-ready -> first optimizer step done:
             # the residual cost warm-compile could not hide (dispatch, any

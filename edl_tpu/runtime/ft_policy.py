@@ -78,6 +78,7 @@ __all__ = [
     "RECONNECT",
     "WARM_RESTART",
     "PARK",
+    "PEER_RESTORE",
     "MODE_CODES",
     "FTPolicyConfig",
     "FTPolicy",
@@ -88,10 +89,15 @@ WAIT = "wait"
 RECONNECT = "reconnect"
 WARM_RESTART = "warm_restart"
 PARK = "park"
+#: restore served from the memory-resident checkpoint plane (peer-replicated
+#: ZeRO shards) instead of the blob store — not an escalation rung but a
+#: restore-source decision, recorded with the same audit machinery.
+PEER_RESTORE = "peer_restore"
 
 #: numeric encoding for the ``edl_ft_policy_mode`` gauge (Prometheus
 #: gauges carry floats; the mapping is part of the metric's contract).
-MODE_CODES: Dict[str, int] = {WAIT: 0, RECONNECT: 1, WARM_RESTART: 2, PARK: 3}
+MODE_CODES: Dict[str, int] = {WAIT: 0, RECONNECT: 1, WARM_RESTART: 2, PARK: 3,
+                              PEER_RESTORE: 4}
 
 
 @dataclass
@@ -195,6 +201,7 @@ class FTPolicy:
         self._step_ema = 0.0
         self._ckpt_ema = 0.0
         self._restore_ema = 0.0
+        self._peer_restore_ema = 0.0
         self._steps_since_ckpt = 0
         # -- incident state (the hysteresis core) --
         #: threshold frozen at incident open; None while healthy.
@@ -221,6 +228,35 @@ class FTPolicy:
 
     def note_restore_cost(self, seconds: float) -> None:
         self._restore_ema = self._ema(self._restore_ema, max(0.0, seconds))
+        self.obs.restore_cost.set(self._restore_ema, source="blob")
+
+    def note_peer_restore(self, seconds: float) -> None:
+        """A restore was served from the checkpoint plane: feed its cost EMA
+        and record the ``peer_restore`` decision (the fallback-ladder audit
+        trail — 'why did this worker NOT read the blob store?')."""
+        self._peer_restore_ema = self._ema(
+            self._peer_restore_ema, max(0.0, seconds))
+        self.obs.restore_cost.set(self._peer_restore_ema, source="peer")
+        self._decide(PEER_RESTORE, seconds)
+
+    def restore_source(self) -> str:
+        """Break-even restore-source choice: ``"peer"`` unless BOTH costs
+        have been measured and the blob restore is cheaper. Optimistic
+        peer-first is safe — an unreadable plane demotes to the blob
+        restore anyway, so the only cost of guessing wrong is one failed
+        in-memory probe; guessing blob wrongly forgoes the fast path."""
+        if (self._peer_restore_ema > 0.0 and self._restore_ema > 0.0
+                and self._restore_ema < self._peer_restore_ema):
+            return "blob"
+        return "peer"
+
+    def effective_restore_cost(self) -> float:
+        """Restore cost the park break-even should price: the cheapest
+        measured source (a worker that restores from peers in 100 ms should
+        not wait out an outage as if it paid the blob read)."""
+        costs = [c for c in (self._restore_ema, self._peer_restore_ema)
+                 if c > 0.0]
+        return min(costs) if costs else 0.0
 
     def restep_cost(self) -> float:
         """Re-train cost of losing uncheckpointed progress right now."""
@@ -229,7 +265,8 @@ class FTPolicy:
     def park_breakeven(self) -> float:
         """Waiting longer than this costs more than parking would."""
         return self.config.park_cost_factor * (
-            self._ckpt_ema + self._restore_ema + self.restep_cost()
+            self._ckpt_ema + self.effective_restore_cost()
+            + self.restep_cost()
         )
 
     # -- history statistics ----------------------------------------------------
@@ -386,6 +423,9 @@ class FTPolicy:
                 else self.threshold(), 3),
             "outage_quantile": round(self.outage_quantile(), 3),
             "park_breakeven": round(self.park_breakeven(), 3),
+            "restore_source": self.restore_source(),
+            "restore_cost_blob": round(self._restore_ema, 3),
+            "restore_cost_peer": round(self._peer_restore_ema, 3),
             "failure_rate_per_min": round(self.failure_rate_per_min(), 3),
             "storm": self.in_storm(),
             "history": len(self.history),

@@ -122,6 +122,16 @@ class MultiHostWorker:
 
         self.client.on_outage_close = _outage_closed
         self.ckpt = Checkpointer(config.checkpoint_dir)
+        #: memory-resident checkpoint plane (None when disabled). Multi-
+        #: controller layout: each process replicates exactly its own rank's
+        #: ZeRO slice — the plane's owner set IS the gang.
+        if config.peer_replicas > 0:
+            from edl_tpu.ckpt_plane import CkptPlane
+
+            self.ckpt_plane: Optional[CkptPlane] = CkptPlane(
+                self.client, replicas=config.peer_replicas)
+        else:
+            self.ckpt_plane = None
         self.steps_done = 0
         self.losses: List[float] = []
         #: rank 0 only: shards consumed since the last durable checkpoint —
@@ -202,11 +212,30 @@ class MultiHostWorker:
 
     def _restore_or_init(self, trainer: Trainer) -> TrainState:
         fresh = trainer.init_state()
-        if self.ckpt.latest_step() is None:
+        blob_step = self.ckpt.latest_step()
+        if (self.ckpt_plane is not None
+                and self.policy.restore_source() == "peer"):
+            t0 = time.monotonic()
+            got = self.ckpt_plane.restore(
+                fresh, trainer.mesh, live_state_specs(fresh),
+                min_step=blob_step,
+            )
+            if got is not None:
+                state, info = got
+                self.policy.note_peer_restore(time.monotonic() - t0)
+                log.info(
+                    "restored step=%s from %d peer shard(s) onto %d-process "
+                    "mesh (%d bytes in memory, zero blob reads)",
+                    info["step"], info["world_at_save"], jax.process_count(),
+                    info["bytes"])
+                return state
+        if blob_step is None:
             return fresh
         state = self.ckpt.restore(
             abstract_like(fresh), trainer.mesh, live_state_specs(fresh)
         )
+        if self.ckpt_plane is not None:
+            self.ckpt_plane.obs.restores.inc(source="blob")
         log.info("restored step=%s onto %d-process mesh",
                  self.ckpt.latest_step(), jax.process_count())
         return state
@@ -480,6 +509,10 @@ class MultiHostWorker:
             info = self.client.register(takeover=True)
         epoch = int(info["epoch"])
         self.obs.note_epoch(epoch)
+        if self.ckpt_plane is not None:
+            # Every rank publishes the identical epoch-scoped placement map
+            # (idempotent kv_put) and invalidates its previous epoch's key.
+            self.ckpt_plane.on_epoch(epoch, world, rank)
 
         mesh = self._build_mesh()
         codec_channel = None
@@ -511,6 +544,10 @@ class MultiHostWorker:
             self.ckpt.save(int(state.step), state)
             self.ckpt.wait()
             self.policy.note_checkpoint_cost(time.monotonic() - ck_t0)
+            if self.ckpt_plane is not None:
+                # Each process pushes its OWN rank's ZeRO slice — the plane
+                # covers the gang when every rank's put lands. Best-effort.
+                self.ckpt_plane.replicate(state, int(state.step), rank, world)
             last_ckpt_step = int(state.step)
             if rank == 0:
                 for t in self._uncommitted:

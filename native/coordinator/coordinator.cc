@@ -424,6 +424,10 @@ class Coordinator {
   std::string op_kv_get(const JsonObject& req);
   std::string op_kv_del(const JsonObject& req);
   std::string op_kv_incr(const JsonObject& req);
+  std::string op_shard_put(const JsonObject& req);
+  std::string op_shard_get(const JsonObject& req);
+  std::string op_shard_meta(const JsonObject& req);
+  std::string op_shard_drop(const JsonObject& req);
   std::string op_bump_epoch();
   std::string op_status();
   std::string op_batch(const JsonObject& req, int fd);
@@ -531,6 +535,25 @@ class Coordinator {
   std::set<std::string> sync_arrived_;
   std::vector<BarrierWaiter> sync_waiters_;
   std::map<std::string, std::string> kv_;
+  // Memory-resident checkpoint plane: the latest replicated ZeRO-1 shard
+  // per owner worker, chunked. DELIBERATELY not journaled — the plane is a
+  // volatile cache of peer state (the blob-store checkpoint stays the
+  // durable tier); after a coordinator restart it is simply empty and
+  // restores fall back to blob. Member drop does NOT clear an owner's
+  // blob: surviving a dead owner is the whole point of the plane.
+  struct ShardBlob {
+    long long step = -1;
+    long long chunks = 0;
+    long long nbytes = 0;
+    std::vector<std::string> group;          // replica-holder worker names
+    std::map<long long, std::string> data;   // chunk index -> payload
+  };
+  std::map<std::string, ShardBlob> shards_;  // owner -> latest blob
+  // put_id dedup (exactly-once under client retry / outbox replay),
+  // FIFO-capped so a long run cannot grow the marker set unboundedly.
+  std::set<std::string> shard_put_seen_;
+  std::deque<std::string> shard_put_order_;
+  static const size_t kShardPutSeenCap = 4096;
   std::vector<std::pair<int, std::string>> deferred_;
   std::string state_file_;
   std::string run_id_;
@@ -1116,6 +1139,112 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   return JsonWriter().field("ok", true).field("value", (double)cur).done();
 }
 
+std::string Coordinator::op_shard_put(const JsonObject& req) {
+  // Checkpoint-plane replication: a worker pushes one chunk of its ZeRO-1
+  // optimizer-state shard into the memory-resident plane. step supersedes:
+  // the plane keeps only the latest replicated step per owner (a restore
+  // wants the freshest covered state; history lives in blob storage).
+  std::string owner = get_str(req, "owner");
+  long long step = (long long)get_num(req, "step", -1);
+  long long chunk = (long long)get_num(req, "chunk", -1);
+  long long chunks = (long long)get_num(req, "chunks", 0);
+  if (owner.empty() || step < 0 || chunks < 1 || chunk < 0 || chunk >= chunks)
+    return JsonWriter().field("ok", false)
+        .field("error", "shard_put requires owner, step>=0, 0<=chunk<chunks")
+        .done();
+  // Exactly-once under retries: a replayed put (lost reply, outbox replay)
+  // acks without re-applying — same contract as acquire req_id / kv_incr
+  // op_id. Marked seen only after a successful apply, so duplicate implies
+  // the original chunk landed.
+  std::string put_id = get_str(req, "put_id");
+  if (!put_id.empty() && shard_put_seen_.count(put_id))
+    return JsonWriter().field("ok", true).field("duplicate", true)
+        .field("stored", true).done();
+  auto& blob = shards_[owner];
+  if (step < blob.step) {
+    // Stale chunk racing a newer replication pass: not an error (the
+    // replicator keeps going), just not stored.
+    return JsonWriter().field("ok", true).field("duplicate", false)
+        .field("stored", false).done();
+  }
+  if (step > blob.step) {
+    blob.step = step;
+    blob.data.clear();
+    blob.group.clear();
+  }
+  blob.chunks = chunks;
+  blob.nbytes = (long long)get_num(req, "nbytes", 0);
+  auto git = req.find("group");
+  if (git != req.end() && git->second.kind == JsonValue::kStrArray)
+    blob.group = git->second.arr;
+  blob.data[chunk] = get_str(req, "data");
+  if (!put_id.empty()) {
+    shard_put_seen_.insert(put_id);
+    shard_put_order_.push_back(put_id);
+    if (shard_put_order_.size() > kShardPutSeenCap) {
+      shard_put_seen_.erase(shard_put_order_.front());
+      shard_put_order_.pop_front();
+    }
+  }
+  return JsonWriter().field("ok", true).field("duplicate", false)
+      .field("stored", true).done();
+}
+
+std::string Coordinator::op_shard_get(const JsonObject& req) {
+  // Recovery path: fetch one chunk of a (possibly dead) owner's replicated
+  // shard. step<0 means "latest"; a specific step must match exactly, so a
+  // restorer never silently mixes chunks from two replication passes.
+  std::string owner = get_str(req, "owner");
+  long long step = (long long)get_num(req, "step", -1);
+  long long chunk = (long long)get_num(req, "chunk", 0);
+  auto it = shards_.find(owner);
+  if (it == shards_.end() || (step >= 0 && it->second.step != step))
+    return JsonWriter().field("ok", true).field("found", false)
+        .field("data", std::string()).field("chunks", (double)0).done();
+  auto cit = it->second.data.find(chunk);
+  if (cit == it->second.data.end())
+    return JsonWriter().field("ok", true).field("found", false)
+        .field("data", std::string()).field("chunks", (double)it->second.chunks)
+        .done();
+  return JsonWriter().field("ok", true).field("found", true)
+      .field("data", cit->second).field("chunks", (double)it->second.chunks)
+      .done();
+}
+
+std::string Coordinator::op_shard_meta(const JsonObject& req) {
+  // What does the plane hold for this owner? complete=true only when every
+  // chunk of the latest step is present — the restorer's go/no-go signal
+  // before it starts pulling chunks (partial replication = blob fallback).
+  std::string owner = get_str(req, "owner");
+  auto it = shards_.find(owner);
+  if (it == shards_.end() || it->second.step < 0)
+    return JsonWriter().field("ok", true).field("found", false)
+        .field("step", (double)-1).field("chunks", (double)0)
+        .field("nbytes", (double)0).field("complete", false)
+        .field("group", std::vector<std::string>{}).done();
+  const ShardBlob& b = it->second;
+  bool complete = b.chunks > 0 && (long long)b.data.size() == b.chunks;
+  return JsonWriter().field("ok", true).field("found", true)
+      .field("step", (double)b.step).field("chunks", (double)b.chunks)
+      .field("nbytes", (double)b.nbytes).field("complete", complete)
+      .field("group", b.group).done();
+}
+
+std::string Coordinator::op_shard_drop(const JsonObject& req) {
+  // Epoch/placement invalidation: drop an owner's replicated state (step<0:
+  // unconditionally; step>=0: only if the plane still holds exactly that
+  // step — a drop racing a newer put must not destroy the newer blob).
+  std::string owner = get_str(req, "owner");
+  long long step = (long long)get_num(req, "step", -1);
+  bool dropped = false;
+  auto it = shards_.find(owner);
+  if (it != shards_.end() && (step < 0 || it->second.step == step)) {
+    shards_.erase(it);
+    dropped = true;
+  }
+  return JsonWriter().field("ok", true).field("dropped", dropped).done();
+}
+
 std::string Coordinator::op_bump_epoch() {
   // Control-plane membership nudge (autoscaler actuation): force every
   // parked sync waiter to resync so live workers observe a rescale without
@@ -1243,6 +1372,10 @@ std::string Coordinator::dispatch(const std::string& op, const JsonObject& req,
   if (op == "kv_get") return op_kv_get(req);
   if (op == "kv_del") return op_kv_del(req);
   if (op == "kv_incr") return op_kv_incr(req);
+  if (op == "shard_put") return op_shard_put(req);
+  if (op == "shard_get") return op_shard_get(req);
+  if (op == "shard_meta") return op_shard_meta(req);
+  if (op == "shard_drop") return op_shard_drop(req);
   if (op == "bump_epoch") return op_bump_epoch();
   if (op == "status") return op_status();
   if (op == "ping") return JsonWriter().field("ok", true).field("pong", true).done();

@@ -1,0 +1,98 @@
+"""Blob-store Checkpointer robustness: torn-write fallback.
+
+A pod dying mid-upload leaves a truncated step directory that orbax still
+lists but cannot read. ``restore(step=None)`` must demote to the previous
+step with an explicit log line — a stale-but-valid restore point beats a
+failed recovery — while an EXPLICIT step keeps exact-step semantics.
+"""
+
+import glob
+import logging
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.models import fit_a_line
+from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.runtime import Trainer, TrainerConfig
+from edl_tpu.runtime.checkpoint import (Checkpointer, abstract_like,
+                                        live_state_specs)
+
+
+def _truncate_step_dir(directory, step):
+    """Corrupt one orbax step dir the way a killed uploader does: every
+    non-empty file cut in half. The dir still lists in ``all_steps()``."""
+    for f in glob.glob(os.path.join(directory, str(step), "**", "*"),
+                       recursive=True):
+        if os.path.isfile(f) and os.path.getsize(f) > 0:
+            with open(f, "r+b") as fh:
+                fh.truncate(os.path.getsize(f) // 2)
+
+
+@pytest.fixture
+def two_step_checkpoint(tmp_path):
+    model = fit_a_line.MODEL
+    mesh = build_mesh(MeshSpec({"data": 4}), jax.devices()[:4])
+    trainer = Trainer(model, mesh, TrainerConfig(optimizer="sgd"))
+    rng = np.random.default_rng(0)
+    state = trainer.init_state()
+    ck = Checkpointer(str(tmp_path / "ck"))
+    saved = {}
+    for ckpt_step in (1, 2):
+        state, _ = trainer.train_step(
+            state, trainer.place_batch(model.synthetic_batch(rng, 16)))
+        ck.save(ckpt_step, state)
+        ck.wait()
+        # host snapshot: the next train_step donates (deletes) these buffers
+        saved[ckpt_step] = jax.device_get(state)
+    yield ck, trainer, mesh, saved
+    ck.close()
+
+
+def test_truncated_latest_step_falls_back_to_previous(two_step_checkpoint,
+                                                      caplog):
+    ck, trainer, mesh, saved = two_step_checkpoint
+    _truncate_step_dir(ck.directory, 2)
+    assert 2 in ck._mngr.all_steps()  # still listed — the trap this guards
+    fresh = trainer.init_state()
+    with caplog.at_level(logging.WARNING, logger="edl_tpu.runtime.checkpoint"):
+        restored = ck.restore(abstract_like(fresh), mesh,
+                              live_state_specs(fresh))
+    assert any("unreadable" in r.message and "falling back" in r.message
+               for r in caplog.records), caplog.records
+    for a, b in zip(jax.tree_util.tree_leaves(saved[1]),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(saved[1].step)
+
+
+def test_explicit_step_keeps_exact_semantics(two_step_checkpoint):
+    """Asking for step 2 by name must surface its corruption, not silently
+    hand back step 1."""
+    ck, trainer, mesh, _ = two_step_checkpoint
+    _truncate_step_dir(ck.directory, 2)
+    fresh = trainer.init_state()
+    with pytest.raises(Exception):
+        ck.restore(abstract_like(fresh), mesh, live_state_specs(fresh), step=2)
+
+
+def test_all_steps_corrupt_raises(two_step_checkpoint):
+    ck, trainer, mesh, _ = two_step_checkpoint
+    _truncate_step_dir(ck.directory, 1)
+    _truncate_step_dir(ck.directory, 2)
+    fresh = trainer.init_state()
+    with pytest.raises(Exception):
+        ck.restore(abstract_like(fresh), mesh, live_state_specs(fresh))
+
+
+def test_empty_directory_still_raises_file_not_found(tmp_path):
+    ck = Checkpointer(str(tmp_path / "empty"))
+    model = fit_a_line.MODEL
+    mesh = build_mesh(MeshSpec({"data": 4}), jax.devices()[:4])
+    trainer = Trainer(model, mesh, TrainerConfig(optimizer="sgd"))
+    fresh = trainer.init_state()
+    with pytest.raises(FileNotFoundError):
+        ck.restore(abstract_like(fresh), mesh, live_state_specs(fresh))
+    ck.close()

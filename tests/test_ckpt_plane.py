@@ -1,0 +1,292 @@
+"""Checkpoint-plane tests: placement, shard codec, zero-blob recovery.
+
+The memory-resident plane must be byte-exact (recovered state EQUALS the
+replicated state — deterministic CPU math turns any serialization defect
+into a hard inequality), must demote cleanly (any gap -> None -> blob),
+and must re-shard across world changes including non-dividing ones
+(6 -> 4) through the same spec machinery the blob restore uses.
+"""
+
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.ckpt_plane import (
+    CkptPlane,
+    assemble_leaves,
+    chunk_blob,
+    leaf_slice,
+    owner_key,
+    parse_shard,
+    placement_map,
+    read_placement,
+    replica_group,
+    serialize_shard,
+)
+from edl_tpu.coordinator import InProcessCoordinator
+from edl_tpu.models import fit_a_line
+from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.runtime import Trainer, TrainerConfig
+from edl_tpu.runtime.data import SyntheticShardSource, shard_names
+from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+from edl_tpu.runtime.checkpoint import live_state_specs
+
+
+def plane_on(coord, name="w0", **kw):
+    client = coord.client(name)
+    client.register()
+    return CkptPlane(client, **kw)
+
+
+def np_state():
+    return {
+        "a": np.arange(48, dtype=np.float32).reshape(12, 4),
+        "b": np.float32(3.5),  # scalar: owned whole by rank 0
+        "c": np.arange(35, dtype=np.int32).reshape(5, 7),  # nothing divides
+    }
+
+
+def np_template():
+    return {
+        "a": np.zeros((12, 4), np.float32),
+        "b": np.float32(0),
+        "c": np.zeros((5, 7), np.int32),
+    }
+
+
+# -- placement -----------------------------------------------------------------
+
+
+def test_replica_group_is_a_ring():
+    assert replica_group(0, 4, 1) == [1]
+    assert replica_group(3, 4, 2) == [0, 1]  # wraps
+    assert replica_group(0, 1, 3) == []  # no peers to hold replicas
+    assert replica_group(1, 3, 5) == [2, 0]  # k capped at world - 1
+
+
+def test_placement_map_covers_every_rank():
+    m = placement_map(4, 2)
+    assert sorted(m) == [0, 1, 2, 3]
+    for r, holders in m.items():
+        assert r not in holders and len(holders) == 2
+
+
+def test_publish_placement_invalidates_previous_epoch():
+    coord = InProcessCoordinator()
+    plane = plane_on(coord, replicas=2)
+    plane.on_epoch(3, world=4, rank=0)
+    doc = read_placement(plane.client, 3)
+    assert doc["world"] == 4 and doc["groups"][1] == [2, 3]
+    plane.on_epoch(4, world=2, rank=0)
+    assert read_placement(plane.client, 3) is None
+    assert read_placement(plane.client, 4)["world"] == 2
+
+
+# -- shard codec ---------------------------------------------------------------
+
+
+def test_leaf_slice_mirrors_zero_shard_layout():
+    arr = np.arange(48, dtype=np.float32).reshape(12, 4)
+    piece, dim = leaf_slice(arr, 2, 6)
+    assert dim == 0
+    np.testing.assert_array_equal(piece, arr[4:6])
+    # nothing divides -> rank 0 owns the whole leaf, others contribute nothing
+    odd = np.arange(35).reshape(5, 7)
+    whole, dim = leaf_slice(odd, 0, 6)
+    assert dim is None
+    np.testing.assert_array_equal(whole, odd)
+    assert leaf_slice(odd, 3, 6) == (None, None)
+
+
+def test_serialize_parse_chunk_roundtrip():
+    leaves = list(np_state().values())
+    blob = serialize_shard(leaves, step=9, rank=1, world=6)
+    manifest, payload = parse_shard(blob)
+    assert manifest["step"] == 9 and manifest["world"] == 6
+    assert sum(m["nbytes"] for m in manifest["leaves"]) == len(payload)
+    # chunking reassembles exactly, and an empty blob still makes one chunk
+    import base64
+
+    chunks = chunk_blob(blob, chunk_bytes=16)
+    assert b"".join(base64.b64decode(c) for c in chunks) == blob
+    assert chunk_blob(b"") == [base64.b64encode(b"").decode("ascii")]
+
+
+def test_parse_shard_rejects_blob_without_manifest():
+    with pytest.raises(ValueError, match="no manifest line"):
+        parse_shard(b"raw bytes only, no newline")
+
+
+def test_assemble_across_non_dividing_world_change_6_to_4():
+    """Satellite: shards written under world=6 reassemble into full leaves,
+    which re-slice under world=4 exactly as slicing the original would —
+    the non-dividing (6 -> 4) rescale path of `zero_shard_spec`'s layout."""
+    leaves = list(np_state().values())
+    parts = {
+        r: parse_shard(serialize_shard(leaves, step=1, rank=r, world=6))
+        for r in range(6)
+    }
+    full = assemble_leaves(parts)
+    for orig, got in zip(leaves, full):
+        np.testing.assert_array_equal(np.asarray(orig), got)
+    # re-shard the reassembled leaves for the new world
+    for orig, got in zip(leaves, full):
+        for rank in range(4):
+            want, wdim = leaf_slice(np.asarray(orig), rank, 4)
+            have, hdim = leaf_slice(got, rank, 4)
+            assert wdim == hdim
+            if want is None:
+                assert have is None
+            else:
+                np.testing.assert_array_equal(want, have)
+
+
+# -- replicate / restore through the coordinator -------------------------------
+
+
+def test_replicate_restore_roundtrip_is_byte_exact():
+    coord = InProcessCoordinator()
+    plane = plane_on(coord, chunk_bytes=64)  # tiny chunks: force batching
+    state = np_state()
+    info = plane.replicate_all(state, step=7, world=2)
+    assert info is not None and info["chunks"] > 2
+    restored, rinfo = plane.restore(np_template())
+    assert rinfo["step"] == 7 and rinfo["source"] == "peer"
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(restored[k]), state[k])
+
+
+def test_restore_reshards_world_6_shards_onto_4_device_mesh():
+    """Replicated at plane-world 6, restored onto a 4-device mesh: the spec
+    machinery re-shards, training continues, values byte-exact."""
+    coord = InProcessCoordinator()
+    plane = plane_on(coord)
+    model = fit_a_line.MODEL
+    mesh8 = build_mesh(MeshSpec({"data": 8}))
+    tr8 = Trainer(model, mesh8, TrainerConfig(optimizer="adam",
+                                              shard_opt_state=True))
+    rng = np.random.default_rng(3)
+    state = tr8.init_state()
+    state, _ = tr8.train_step(state,
+                              tr8.place_batch(model.synthetic_batch(rng, 16)))
+    assert plane.replicate_all(state, step=1, world=6) is not None
+
+    mesh4 = build_mesh(MeshSpec({"data": 4}), jax.devices()[:4])
+    tr4 = Trainer(model, mesh4, TrainerConfig(optimizer="adam",
+                                              shard_opt_state=True))
+    fresh = tr4.init_state()
+    restored, rinfo = plane.restore(fresh, mesh4, live_state_specs(fresh))
+    assert rinfo["world_at_save"] == 6
+    for a, b in zip(jax.tree_util.tree_leaves(state),
+                    jax.tree_util.tree_leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the restored state actually steps on the new mesh
+    tr4.train_step(restored, tr4.place_batch(model.synthetic_batch(rng, 16)))
+
+
+def test_single_lost_owner_demotes_to_none(caplog):
+    coord = InProcessCoordinator()
+    plane = plane_on(coord)
+    plane.replicate_all(np_state(), step=5, world=4)
+    plane.drop_owner(2)
+    with caplog.at_level(logging.WARNING, logger="edl_tpu.ckpt_plane"):
+        assert plane.restore(np_template()) is None
+    assert any("falling back to blob restore" in r.message
+               for r in caplog.records)
+
+
+def test_whole_group_death_demotes_to_none():
+    coord = InProcessCoordinator()
+    plane = plane_on(coord)
+    plane.replicate_all(np_state(), step=5, world=3)
+    for r in range(3):
+        plane.drop_owner(r)
+    assert plane.restore(np_template()) is None
+
+
+def test_min_step_floor_rejects_stale_plane():
+    """The plane must never move training backwards past the blob store."""
+    coord = InProcessCoordinator()
+    plane = plane_on(coord)
+    plane.replicate_all(np_state(), step=5, world=2)
+    assert plane.restore(np_template(), min_step=6) is None
+    restored, rinfo = plane.restore(np_template(), min_step=5)
+    assert rinfo["step"] == 5
+
+
+def test_duplicate_put_replay_is_idempotent():
+    """Re-sending a chunk with the same put_id (transport retry) must not
+    corrupt the stored shard; restore stays byte-exact."""
+    coord = InProcessCoordinator()
+    plane = plane_on(coord, chunk_bytes=64)
+    state = np_state()
+    plane.replicate_all(state, step=3, world=2)
+    meta = plane.client.shard_meta(owner_key(0))
+    reply = plane.client.shard_put(
+        owner_key(0), 3, 0, int(meta["chunks"]), "Z0JBRA==",  # wrong payload
+        put_id="z0.s3.c0",  # ...but a replayed id: must dedup, not overwrite
+    )
+    assert reply.get("ok") and reply.get("duplicate")
+    restored, _ = plane.restore(np_template())
+    for k in state:
+        np.testing.assert_array_equal(np.asarray(restored[k]), state[k])
+
+
+def test_stale_step_put_does_not_regress_latest():
+    coord = InProcessCoordinator()
+    plane = plane_on(coord)
+    plane.replicate_all(np_state(), step=9, world=2)
+    old = {"a": np.ones((12, 4), np.float32), "b": np.float32(0),
+           "c": np.zeros((5, 7), np.int32)}
+    plane.replicate_all(old, step=4, world=2)  # late-arriving stale writer
+    restored, rinfo = plane.restore(np_template())
+    assert rinfo["step"] == 9
+    np.testing.assert_array_equal(np.asarray(restored["a"]),
+                                  np_state()["a"])
+
+
+def test_ckpt_plane_rejects_zero_replicas():
+    coord = InProcessCoordinator()
+    with pytest.raises(ValueError, match="replicas"):
+        plane_on(coord, replicas=0)
+
+
+# -- worker integration --------------------------------------------------------
+
+
+def test_elastic_worker_replicates_then_peer_restores(tmp_path):
+    """e2e: a plane-enabled worker covers its checkpoints with peer shards;
+    a successor worker restores from coordinator memory, not the blob."""
+    coord = InProcessCoordinator(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    model = fit_a_line.MODEL
+    admin = coord.client("admin")
+    admin.add_tasks(shard_names("fit", 3))
+    cfg = ElasticConfig(
+        checkpoint_dir=str(tmp_path / "ck"),
+        checkpoint_interval=4,
+        heartbeat_interval=0.0,
+        trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+        peer_replicas=1,
+    )
+    w1 = ElasticWorker(model, coord.client("trainer-0"),
+                       SyntheticShardSource(model, batch_size=8,
+                                            batches_per_shard=4), cfg)
+    w1.run()
+    # the final checkpoint was covered by a complete plane shard — probe
+    # with an UNregistered client: a registered bystander would join the
+    # membership and stall w2's rescale sync barrier until it times out
+    meta = coord.client("probe").shard_meta(owner_key(0))
+    assert meta.get("found") and meta.get("complete"), meta
+
+    # explicit leave in lieu of waiting out the heartbeat TTL: a lingering
+    # trainer-0 membership would park w2's epoch sync until it times out
+    coord.client("trainer-0").leave()
+    admin.add_tasks(shard_names("more", 2))
+    w2 = ElasticWorker(model, coord.client("trainer-1"),
+                       SyntheticShardSource(model, batch_size=8,
+                                            batches_per_shard=4), cfg)
+    w2.run()
+    assert w2._last_restore["source"] == "peer", w2._last_restore
+    assert w2._last_restore["bytes"] > 0

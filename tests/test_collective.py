@@ -77,6 +77,32 @@ def test_zero_shard_spec_flat_and_hierarchical():
     )
 
 
+def test_zero_shard_spec_across_non_dividing_world_change():
+    """The 6 -> 4 rescale: neither world divides the other, so every leaf's
+    shard dim is re-derived per mesh — some leaves change layout (divisible
+    by 6 only), some pick a different dim, some go replicated. The spec
+    must be consistent per (shape, mesh), which is all the checkpoint
+    plane's reassemble-then-reshard recovery relies on."""
+    import jax as _jax
+
+    mesh6 = build_mesh(MeshSpec({"data": 6}), _jax.devices()[:6])
+    mesh4 = build_mesh(MeshSpec({"data": 4}), _jax.devices()[:4])
+    # divides both worlds, but on a different dim (24 % 6 == 24 % 4 == 0)
+    assert zero_shard_spec((24, 4), mesh6, "data") == P("data", None)
+    assert zero_shard_spec((24, 4), mesh4, "data") == P("data", None)
+    # divides 6 only -> replicated at world 4 (the blob/plane restore path
+    # must therefore never assume the shard dim survives a rescale)
+    assert zero_shard_spec((18, 5), mesh6, "data") == P("data", None)
+    assert zero_shard_spec((18, 5), mesh4, "data") is None
+    # divides 4 only -> sharded only after the shrink
+    assert zero_shard_spec((8, 3), mesh6, "data") is None
+    assert zero_shard_spec((8, 3), mesh4, "data") == P("data", None)
+    # largest-divisible dim FLIPS across the change: 12 wins at world 6
+    # (16 % 6 != 0), 16 wins at world 4
+    assert zero_shard_spec((12, 16), mesh6, "data") == P("data", None)
+    assert zero_shard_spec((12, 16), mesh4, "data") == P(None, "data")
+
+
 def test_shard_opt_state_shards_largest_dim():
     """`Trainer._shard_opt_state` places every moment on its
     `zero_shard_spec` layout — the LARGEST divisible dim, not the first.

@@ -469,3 +469,54 @@ def test_scenario_rejects_unregistered_names():
     sc = ChaosScenario("bad").add("ghost")
     with pytest.raises(ValueError, match="ghost"):
         sc.start()
+
+
+# -- restore-source break-even (checkpoint plane) ------------------------------
+
+
+def test_restore_source_defaults_to_peer_until_both_measured():
+    """Optimistic peer-first: an unreadable plane demotes to blob anyway,
+    so guessing peer costs one failed in-memory probe at most."""
+    p, _, _, _ = make_policy()
+    assert p.restore_source() == "peer"
+    p.note_restore_cost(5.0)  # only blob measured
+    assert p.restore_source() == "peer"
+    p.note_peer_restore(0.2)  # both measured, peer cheaper
+    assert p.restore_source() == "peer"
+
+
+def test_restore_source_flips_to_blob_when_measurably_cheaper():
+    p, _, _, _ = make_policy()
+    p.note_peer_restore(4.0)
+    p.note_restore_cost(0.5)
+    assert p.restore_source() == "blob"
+
+
+def test_effective_restore_cost_prices_the_cheapest_source():
+    p, _, _, _ = make_policy()
+    assert p.effective_restore_cost() == 0.0
+    p.note_restore_cost(5.0)
+    assert p.effective_restore_cost() == 5.0
+    p.note_peer_restore(0.5)
+    assert p.effective_restore_cost() == 0.5
+    # the park break-even reflects the fast source, not the blob read
+    p.note_checkpoint_cost(1.0)
+    cfg = p.config
+    assert p.park_breakeven() == pytest.approx(
+        cfg.park_cost_factor * (p._ckpt_ema + 0.5 + p.restep_cost()))
+
+
+def test_note_peer_restore_records_decision_and_gauges():
+    from edl_tpu.runtime.ft_policy import MODE_CODES, PEER_RESTORE
+
+    p, _, reg, _ = make_policy()
+    p.note_peer_restore(0.25)
+    assert MODE_CODES[PEER_RESTORE] == 4
+    families = parse_prometheus(reg.render_prometheus())
+    decisions = families["edl_ft_policy_decisions_total"]["samples"]
+    assert decisions['edl_ft_policy_decisions_total{mode="peer_restore"}'] == 1.0
+    costs = families["edl_ft_policy_restore_cost_seconds"]["samples"]
+    assert costs['edl_ft_policy_restore_cost_seconds{source="peer"}'] == 0.25
+    st = p.state()
+    assert st["restore_source"] == "peer"
+    assert st["restore_cost_peer"] == 0.25

@@ -65,8 +65,9 @@ def test_default_exhaustive_is_green_and_fully_replayed():
     result = run_default()
     elapsed = time.monotonic() - t0
     assert result.violations == []
-    # C(13, 6) interleavings of the two scripts
-    assert result.traces == 1716
+    # C(13, 6) interleavings of the default scripts + C(8, 4) of the
+    # checkpoint-plane schedule (run_default merges both)
+    assert result.traces == 1716 + 70
     assert result.replays == result.traces
     assert result.ok()
     assert elapsed < 60.0
@@ -115,7 +116,8 @@ def test_mutant_violation_messages_name_the_replayed_request():
 def test_fuzz_on_green_twin_stays_green():
     result = run_default(fuzz_samples=40, fuzz_seed=7)
     assert result.violations == []
-    assert 0 < result.traces <= 40  # identical schedules dedup
+    # 40 samples per schedule (default + ckpt-plane), identical ones dedup
+    assert 0 < result.traces <= 80
     assert result.replays == result.traces
 
 
@@ -228,7 +230,7 @@ def test_cli_exhaustive_exits_zero(capsys):
     rc = modelcheck_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "1716 trace(s)" in out and "0 violation(s)" in out
+    assert "1786 trace(s)" in out and "0 violation(s)" in out
 
 
 def test_cli_json_fuzz(capsys):
