@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck tsan-smoke verify bench-pipeline bench-coord bench-collective bench-serve
+.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck tsan-smoke bench-coord-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -82,11 +82,21 @@ tsan-smoke:
 			$(PYTHON) -m pytest tests/ -q -m 'sanitizer and not slow'; \
 	fi
 
+## Bench-harness deploy gate: a <60 s slice of bench_coord.py — both
+## topologies (single vs sharded, N=500, multiplexed connections) plus a
+## fast pull-vs-push epoch-propagation pair — written to a throwaway path
+## with plausibility assertions (every cell beats, push faster than pull).
+## Catches harness rot without paying for the full sweep; skips cleanly
+## when the native toolchain is absent.
+bench-coord-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_coord.py --smoke
+
 ## Everything a PR must pass: static analysis (EDL001-EDL009 vs baseline +
 ## protocol_schema.json ratchet), tier-1 tests, protocol model check,
-## serving smoke, TSan lane. Tier-2 (slow, run before cutting a release):
-## `make chaos` and `make chaos-composed` — soaks + composed cross-axis run.
-verify: analyze test modelcheck serve-smoke ckpt-plane-smoke tsan-smoke
+## serving smoke, TSan lane, bench-harness smoke. Tier-2 (slow, run before
+## cutting a release): `make chaos` / `make chaos-composed` — soaks +
+## composed cross-axis run.
+verify: analyze test modelcheck serve-smoke ckpt-plane-smoke tsan-smoke bench-coord-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -29,11 +29,45 @@ snapshot compactions, and server CPU-seconds per kop (from
 machine, so absolute throughput is a floor and CPU-seconds/op plus the
 BETWEEN-ARM ratios are the meaningful numbers.
 
+Beyond the protocol arms, two further sections (EDL_COORD_SECTIONS):
+
+- ``topology`` — single coordinator vs the sharded control plane
+  (`ShardedCoordinator`: thin root + hash-partitioned shard servers) at
+  N in {10k, 50k, 100k} LOGICAL workers. Per-worker sockets hit the fd
+  rlimit long before 100k, so this section multiplexes logical workers
+  over a bounded connection pool (EDL_COORD_MAX_CONNS): server-side
+  state and per-op work scale with N while the socket count stays
+  fixed — state-size scaling is what single-vs-sharded differ on, not
+  fd count. Sharded beats go straight to the owning shard (the real
+  client routes there after its first redirect) and carry the same
+  batch[heartbeat, kv_put] frame; liveness refresh is delegated to the
+  shard the worker's traffic lands on, while the root holds the global
+  membership of record + shard map (registered untimed at setup) — the
+  thin-root design point: pushing every beat through the root would
+  just re-centralize it. Beat kv values carry EDL_COORD_KV_BYTES of
+  payload (default 1 KiB): the traffic sharding exists for is
+  checkpoint-plane/state publishes whose journal bytes dominate, not
+  bare heartbeats — with tiny values neither server is the bottleneck
+  behind the bench's own client loop and the cell measures nothing.
+- ``propagation`` — pull-vs-push epoch discovery latency: N workers
+  heartbeat at the configured period (phase-spread, the pull baseline)
+  or hold ``watch`` subscriptions (push); one bump_epoch, and the
+  per-worker delay from bump to discovery is the distribution. Push
+  must land well under the heartbeat period (the worker acts in ~one
+  RTT instead of waiting out its poll cadence).
+
 Env: EDL_COORD_NS ([100,1000,10000]), EDL_COORD_SECS (4.0 measured
 window), EDL_COORD_WARMUP (0.5), EDL_COORD_ARMS (["before","after"]),
 EDL_COORD_WAVE (128 — registration wave size, bounded by the server's
-listen backlog), EDL_COORD_OUT (output path). Writes BENCH_COORD.json
-next to this file and prints a one-line summary JSON.
+listen backlog), EDL_COORD_SECTIONS (["arms","topology","propagation"]),
+EDL_COORD_SHARD_NS ([10000,50000,100000]), EDL_COORD_MAX_CONNS (1024),
+EDL_COORD_KV_BYTES (1024 — topology beat kv payload size),
+EDL_COORD_PROP_WORKERS (200), EDL_COORD_PROP_PERIOD (1.0 s),
+EDL_COORD_OUT (output path). Writes BENCH_COORD.json next to this file
+and prints a one-line summary JSON. ``--smoke`` runs a <60 s sanity
+slice (N=500, both topologies, plus a fast propagation pair) to a
+throwaway path and exits nonzero if any cell is implausible — the
+`make verify` hook for this harness.
 """
 
 from __future__ import annotations
@@ -76,7 +110,7 @@ class Sim:
     """
 
     __slots__ = ("sock", "name", "out", "expect", "t_send", "stages",
-                 "stage", "beats", "raw", "capture")
+                 "stage", "beats", "raw", "capture", "gen", "next_due")
 
     def __init__(self, sock: socket.socket, name: str):
         self.sock = sock
@@ -89,6 +123,8 @@ class Sim:
         self.beats = 0
         self.raw = b""       # reply capture (registration validation only)
         self.capture = False
+        self.gen = None      # optional () -> stages, rebuilt per beat (mux)
+        self.next_due = 0.0  # paced (open-loop) send time; propagation only
 
 
 def _flush(sel: selectors.DefaultSelector, s: Sim) -> None:
@@ -139,6 +175,8 @@ def _handle(sel, key, mask, lats, reissue: bool) -> None:
                     if lats is not None:
                         lats.append(time.monotonic() - s.t_send)
                     if reissue:
+                        if s.gen is not None:
+                            s.stages = s.gen()  # next logical worker's beat
                         _send_stage(sel, s, 0)
 
 
@@ -296,11 +334,352 @@ def run_cell(arm: str, n: int, mode: str, secs: float, warmup: float,
         os.environ.pop("EDL_COORD_FORCE_POLL", None)
 
 
+def _open_conns(sel, port: int, count: int) -> list:
+    """``count`` raw multiplexer connections (no per-socket registration)."""
+    conns = []
+    for i in range(count):
+        sk = socket.create_connection(("127.0.0.1", port), timeout=10.0)
+        sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sk.setblocking(False)
+        s = Sim(sk, f"conn{i:04d}")
+        sel.register(sk, selectors.EVENT_READ, s)
+        conns.append(s)
+    return conns
+
+
+def _register_logical(sel, conns: list, assignment: list) -> None:
+    """Register every logical worker, pipelined over its connection.
+
+    ``assignment[i]`` is the name list conn ``i`` registers (and later
+    beats for). One stage per conn: all register frames concatenated,
+    replies counted by line — validated by scanning for ok:true exactly
+    ``len(names)`` times.
+    """
+    for s, names in zip(conns, assignment):
+        if not names:
+            continue
+        payload = b"".join(
+            _frame({"op": "register", "worker": nm}) for nm in names)
+        s.stages = [(payload, len(names))]
+        s.capture = True
+        _send_stage(sel, s, 0)
+    deadline = time.monotonic() + 120.0
+    while any(s.expect > 0 for s in conns):
+        if time.monotonic() > deadline:
+            stuck = sum(1 for s in conns if s.expect > 0)
+            raise RuntimeError(f"logical registration stalled ({stuck} conns)")
+        for key, mask in sel.select(timeout=0.5):
+            _handle(sel, key, mask, None, reissue=False)
+    for s, names in zip(conns, assignment):
+        acked = s.raw.count(b'"ok":true')
+        if names and acked != len(names):
+            raise RuntimeError(
+                f"{s.name}: {acked}/{len(names)} registrations acked ok")
+        s.raw = b""
+        s.capture = False
+        s.beats = 0
+
+
+def _mux_gen(names: list, kv_bytes: int):
+    """Beat generator cycling a connection's logical workers: each beat is
+    the NEXT worker's batch[heartbeat, kv_put] — the batched protocol
+    shape, identical under both topologies. The kv value carries
+    ``kv_bytes`` of payload: the traffic class sharding exists for is
+    checkpoint-plane/state publishes (KB-scale values that dominate the
+    journal), not bare heartbeats — tiny values leave the server far from
+    saturated behind the bench's own client loop and measure nothing."""
+    state = {"i": 0}
+    val = "x" * max(1, kv_bytes)
+
+    def gen():
+        nm = names[state["i"] % len(names)]
+        state["i"] += 1
+        hb = {"op": "heartbeat", "worker": nm}
+        kv = {"op": "kv_put", "worker": nm, "key": f"bench/{nm}",
+              "value": val}
+        return [(_frame({
+            "op": "batch", "worker": nm,
+            "ops": [json.dumps(hb, separators=(",", ":")),
+                    json.dumps(kv, separators=(",", ":"))],
+        }), 1)]
+
+    return gen
+
+
+def _sum_counters(clients: list) -> dict:
+    total: dict = {}
+    for c in clients:
+        for k, v in _counters(c.status()).items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def run_topology_cell(topology: str, n: int, secs: float, warmup: float,
+                      max_conns: int, tmpdir: str,
+                      kv_bytes: int = 1024) -> dict:
+    """One measured window of the single-vs-sharded comparison at ``n``
+    LOGICAL workers multiplexed over ``min(n, max_conns)`` connections."""
+    from edl_tpu.coordinator.server import CoordinatorServer, ShardedCoordinator
+    from edl_tpu.coordinator.sharding import shard_of
+
+    os.environ.pop("EDL_COORD_FORCE_POLL", None)
+    # Window scaled with N so steady state includes snapshot compaction:
+    # the journal compacts every ~2N appended records, and writing an
+    # O(state)-sized snapshot is exactly the stall that grows with fleet
+    # size (and that partitioning halves + overlaps). A short window at
+    # large N would sample only the append-path steady state where the
+    # topologies tie, and silently miss the tail event being measured.
+    secs = max(secs, n / 2500.0)
+    names = [f"w{i:06d}" for i in range(n)]
+    nconns = min(n, max_conns)
+    sel = selectors.DefaultSelector()
+    cleanup = []
+    try:
+        if topology == "single":
+            server = CoordinatorServer(
+                task_lease_sec=600.0, heartbeat_ttl_sec=600.0, auth_token="",
+                state_file=os.path.join(tmpdir, f"single-{n}.state"))
+            server.start()
+            cleanup.append(server.stop)
+            conns = _open_conns(sel, server.port, nconns)
+            _register_logical(sel, conns,
+                              [names[i::nconns] for i in range(nconns)])
+            for s, chunk in zip(conns, [names[i::nconns]
+                                        for i in range(nconns)]):
+                s.gen = _mux_gen(chunk, kv_bytes)
+                s.stages = s.gen()
+            ctls = [server.client("bench-ctl")]
+            pids = [server._proc.pid]
+        else:
+            sc = ShardedCoordinator(
+                num_shards=2, task_lease_sec=600.0, heartbeat_ttl_sec=600.0,
+                auth_token="", state_dir=os.path.join(tmpdir, f"sh-{n}"))
+            os.makedirs(os.path.join(tmpdir, f"sh-{n}"), exist_ok=True)
+            sc.start()
+            cleanup.append(sc.stop)
+            nsh = len(sc.shards)
+            # Partition logical workers by the shard owning their kv key —
+            # exactly where the routed client sends this beat's keyspace op.
+            by_shard: list = [[] for _ in range(nsh)]
+            for nm in names:
+                by_shard[shard_of(f"bench/{nm}", nsh)].append(nm)
+            # Root holds the global membership of record (untimed setup).
+            root_conns = _open_conns(sel, sc.root.port, min(nconns, 32))
+            _register_logical(
+                sel, root_conns,
+                [names[i::len(root_conns)] for i in range(len(root_conns))])
+            for s in root_conns:
+                sel.unregister(s.sock)
+                s.sock.close()
+            conns = []
+            per = max(1, nconns // nsh)
+            for si, shard in enumerate(sc.shards):
+                shard_conns = _open_conns(sel, shard.port, per)
+                chunks = [by_shard[si][j::per] for j in range(per)]
+                _register_logical(sel, shard_conns, chunks)
+                for s, chunk in zip(shard_conns, chunks):
+                    if chunk:
+                        s.gen = _mux_gen(chunk, kv_bytes)
+                        s.stages = s.gen()
+                conns += [s for s, chunk in zip(shard_conns, chunks) if chunk]
+            ctls = [sv.client("bench-ctl") for sv in [sc.root] + sc.shards]
+            pids = [sv._proc.pid for sv in [sc.root] + sc.shards]
+
+        _pump(sel, conns, warmup)
+        c0 = _sum_counters(ctls)
+        cpu0 = sum(_server_cpu_seconds(p) for p in pids)
+        lats: list = []
+        t0 = time.monotonic()
+        _pump(sel, conns, secs, lats)
+        dt = time.monotonic() - t0
+        c1 = _sum_counters(ctls)
+        cpu1 = sum(_server_cpu_seconds(p) for p in pids)
+        for c in ctls:
+            c.close()
+
+        d = {k: c1[k] - c0[k] for k in c0}
+        beats = len(lats)
+        lats_ms = sorted(x * 1000.0 for x in lats)
+        ops = d["ops"]
+        return {
+            "topology": topology, "n": n, "mode": "saturated",
+            "kv_bytes": kv_bytes,
+            "connections": len(conns), "seconds": round(dt, 3),
+            "servers": len(pids),
+            "beats": beats,
+            "beats_per_sec": round(beats / dt, 1),
+            "ops_per_sec": round(ops / dt, 1),
+            "p50_ms": round(statistics.median(lats_ms), 3) if lats_ms else None,
+            "p99_ms": round(lats_ms[max(0, int(len(lats_ms) * 0.99) - 1)], 3)
+            if lats_ms else None,
+            "fsyncs_per_sec": round(d["fsyncs"] / dt, 2),
+            "ops_per_fsync": round(ops / d["fsyncs"], 1) if d["fsyncs"] else None,
+            "journal_records": d["journal_records"],
+            "snapshots": d["snapshots"],
+            "server_cpu_sec": round(cpu1 - cpu0, 3),
+            "server_cpu_sec_per_kop": round((cpu1 - cpu0) / ops * 1000.0, 4)
+            if ops else None,
+        }
+    finally:
+        for key in list(sel.get_map().values()):
+            key.fileobj.close()
+        sel.close()
+        for fn in cleanup:
+            fn()
+
+
+def run_propagation(workers: int, period: float, tmpdir: str) -> dict:
+    """Pull-vs-push epoch propagation: one bump_epoch, per-worker delay
+    from bump to discovery.
+
+    Pull: every worker heartbeats open-loop at ``period`` with uniformly
+    spread phases (the fleet's real cadence after jitter de-correlates
+    it); discovery is the first reply stamped with the new epoch. Push:
+    every worker holds a ``watch`` subscription; discovery is the
+    notification frame's arrival.
+    """
+    from edl_tpu.coordinator.server import CoordinatorServer
+
+    os.environ.pop("EDL_COORD_FORCE_POLL", None)
+    server = CoordinatorServer(
+        task_lease_sec=600.0, heartbeat_ttl_sec=600.0, auth_token="",
+        state_file=os.path.join(tmpdir, "prop.state"))
+    server.start()
+    sel = selectors.DefaultSelector()
+    try:
+        ctl = server.client("bench-ctl")
+
+        def quantiles(lat: list) -> dict:
+            ms = sorted(x * 1000.0 for x in lat)
+            return {
+                "discovered": len(ms),
+                "mean_ms": round(sum(ms) / len(ms), 3) if ms else None,
+                "p50_ms": round(statistics.median(ms), 3) if ms else None,
+                "p99_ms": round(ms[max(0, int(len(ms) * 0.99) - 1)], 3)
+                if ms else None,
+            }
+
+        # -- pull arm ---------------------------------------------------------
+        sims = _connect_and_register(sel, server.port, workers, 128)
+        e1 = int(ctl.status()["epoch"]) + 1
+        marker = f'"epoch":{e1}'.encode()
+        for s in sims:
+            s.stages = [(_frame({"op": "heartbeat", "worker": s.name}), 1)]
+            s.capture = True
+        assert int(ctl.bump_epoch()) == e1
+        t_bump = time.monotonic()
+        # Poll phases uniform over (0, period) relative to the bump: real
+        # fleets de-correlate heartbeats with jitter, so a rescale lands at
+        # a uniformly random point of each worker's cycle — mean discovery
+        # delay period/2, p99 ~ period. Each worker's first paced beat
+        # after the bump already carries the new epoch stamp.
+        for i, s in enumerate(sims):
+            s.next_due = t_bump + (i + 0.5) / workers * period
+        pull_lat: list = []
+        pending = set(range(len(sims)))
+        deadline = t_bump + 3.0 * period + 2.0
+        while pending and time.monotonic() < deadline:
+            now = time.monotonic()
+            for i in list(pending):
+                s = sims[i]
+                if s.stage < 0 and now >= s.next_due:
+                    s.raw = b""
+                    _send_stage(sel, s, 0)
+                    s.next_due += period
+            for key, mask in sel.select(timeout=0.01):
+                _handle(sel, key, mask, None, reissue=False)
+            now = time.monotonic()
+            for i in list(pending):
+                if marker in sims[i].raw:
+                    pull_lat.append(now - t_bump)
+                    pending.discard(i)
+        if pending:
+            raise RuntimeError(
+                f"pull arm: {len(pending)} workers never saw epoch {e1}")
+        for s in sims:
+            sel.unregister(s.sock)
+            s.sock.close()
+
+        # -- push arm ---------------------------------------------------------
+        e1 = int(ctl.status()["epoch"])
+        e2 = e1 + 1
+        marker = f'"epoch":{e2}'.encode()
+        watchers = []
+        for i in range(workers):
+            sk = socket.create_connection(("127.0.0.1", server.port),
+                                          timeout=10.0)
+            sk.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sk.settimeout(10.0)
+            sk.sendall(_frame({"op": "watch", "worker": f"w{i:05d}",
+                               "cursor": e1}))
+            ack = b""
+            while b"\n" not in ack:
+                chunk = sk.recv(4096)
+                if not chunk:
+                    raise RuntimeError("watch subscribe: connection closed")
+                ack += chunk
+            if b'"watch":true' not in ack.split(b"\n", 1)[0]:
+                raise RuntimeError(f"watch subscribe failed: {ack!r}")
+            sk.setblocking(False)
+            s = Sim(sk, f"w{i:05d}")
+            s.capture = True
+            s.raw = ack.split(b"\n", 1)[1]
+            sel.register(sk, selectors.EVENT_READ, s)
+            watchers.append(s)
+        assert int(ctl.bump_epoch()) == e2
+        t_bump = time.monotonic()
+        push_lat: list = []
+        pending = set(range(workers))
+        deadline = t_bump + 10.0
+        while pending and time.monotonic() < deadline:
+            for key, mask in sel.select(timeout=0.01):
+                s: Sim = key.data
+                try:
+                    data = s.sock.recv(1 << 16)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                if not data:
+                    raise RuntimeError("watch connection closed mid-wait")
+                s.raw += data
+            now = time.monotonic()
+            for i in list(pending):
+                if marker in watchers[i].raw:
+                    push_lat.append(now - t_bump)
+                    pending.discard(i)
+        if pending:
+            raise RuntimeError(
+                f"push arm: {len(pending)} watchers never got epoch {e2}")
+        ctl.close()
+
+        pull, push = quantiles(pull_lat), quantiles(push_lat)
+        return {
+            "workers": workers,
+            "heartbeat_period_s": period,
+            "pull": pull,
+            "push": push,
+            "push_speedup_mean":
+            round(pull["mean_ms"] / push["mean_ms"], 1)
+            if push["mean_ms"] else None,
+            # the acceptance ratio: push p99 as a fraction of the
+            # heartbeat period the pull path is bound by
+            "push_p99_over_period":
+            round(push["p99_ms"] / (period * 1000.0), 4)
+            if push["p99_ms"] is not None else None,
+        }
+    finally:
+        for key in list(sel.get_map().values()):
+            key.fileobj.close()
+        sel.close()
+        server.stop()
+
+
 def main() -> dict:
     soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
     if soft < hard:
         resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
 
+    sections = _env_list("EDL_COORD_SECTIONS",
+                         ["arms", "topology", "propagation"])
     ns = [int(x) for x in _env_list("EDL_COORD_NS", [100, 1000, 10000])]
     arms = _env_list("EDL_COORD_ARMS", ["before", "after"])
     modes = _env_list("EDL_COORD_MODES", ["saturated", "duty"])
@@ -308,16 +687,35 @@ def main() -> dict:
     warmup = _env_float("EDL_COORD_WARMUP", 0.5)
     wave = int(_env_float("EDL_COORD_WAVE", 128))
     active = int(_env_float("EDL_COORD_ACTIVE", 64))
+    shard_ns = [int(x) for x in
+                _env_list("EDL_COORD_SHARD_NS", [10000, 50000, 100000])]
+    max_conns = int(_env_float("EDL_COORD_MAX_CONNS", 1024))
+    kv_bytes = int(_env_float("EDL_COORD_KV_BYTES", 1024))
+    prop_workers = int(_env_float("EDL_COORD_PROP_WORKERS", 200))
+    prop_period = _env_float("EDL_COORD_PROP_PERIOD", 1.0)
 
-    results = []
+    results: list = []
+    topo_results: list = []
+    propagation = None
     with tempfile.TemporaryDirectory(prefix="edl-bench-coord-") as tmpdir:
-        for n in ns:
-            for mode in modes:
-                for arm in arms:
-                    cell = run_cell(arm, n, mode, secs, warmup, wave,
-                                    active, tmpdir)
+        if "arms" in sections:
+            for n in ns:
+                for mode in modes:
+                    for arm in arms:
+                        cell = run_cell(arm, n, mode, secs, warmup, wave,
+                                        active, tmpdir)
+                        print(json.dumps(cell))
+                        results.append(cell)
+        if "topology" in sections:
+            for n in shard_ns:
+                for topology in ("single", "sharded"):
+                    cell = run_topology_cell(topology, n, secs, warmup,
+                                             max_conns, tmpdir, kv_bytes)
                     print(json.dumps(cell))
-                    results.append(cell)
+                    topo_results.append(cell)
+        if "propagation" in sections:
+            propagation = run_propagation(prop_workers, prop_period, tmpdir)
+            print(json.dumps(propagation))
 
     by = {(c["arm"], c["n"], c["mode"]): c for c in results}
     crossover = []
@@ -340,25 +738,117 @@ def main() -> dict:
                 if b["server_cpu_sec_per_kop"] and a["server_cpu_sec_per_kop"]
                 else None,
             })
+
+    tby = {(c["topology"], c["n"]): c for c in topo_results}
+    topo_crossover = []
+    for n in shard_ns:
+        s1 = tby.get(("single", n))
+        sh = tby.get(("sharded", n))
+        if not (s1 and sh):
+            continue
+        topo_crossover.append({
+            "n": n,
+            "beats_speedup":
+            round(sh["beats_per_sec"] / s1["beats_per_sec"], 2)
+            if s1["beats_per_sec"] else None,
+            "p99_ratio": round(s1["p99_ms"] / sh["p99_ms"], 2)
+            if s1["p99_ms"] and sh["p99_ms"] else None,
+            "cpu_per_kop_ratio":
+            round(s1["server_cpu_sec_per_kop"]
+                  / sh["server_cpu_sec_per_kop"], 2)
+            if s1["server_cpu_sec_per_kop"] and sh["server_cpu_sec_per_kop"]
+            else None,
+        })
+
     out = {
         "bench": "coordinator_control_plane",
-        "config": {"ns": ns, "arms": arms, "modes": modes, "seconds": secs,
+        "config": {"sections": sections, "ns": ns, "arms": arms,
+                   "modes": modes, "seconds": secs,
                    "warmup": warmup, "active_workers_duty": active,
+                   "shard_ns": shard_ns, "max_conns": max_conns,
+                   "kv_bytes": kv_bytes,
+                   "propagation_workers": prop_workers,
+                   "propagation_period_s": prop_period,
                    "cpus": os.cpu_count(),
                    "note": "bench and server share the host; ratios between "
                            "arms are the meaningful numbers. The before arm "
                            "understates the seed server (lease index + tick "
-                           "cache benefit both arms)."},
+                           "cache benefit both arms). Topology cells "
+                           "multiplex logical workers over a bounded "
+                           "connection pool (fd rlimit); on a 1-core host "
+                           "the sharded win comes from overlapping journal "
+                           "fsync waits and smaller per-shard state, not "
+                           "parallel compute."},
         "results": results,
         "crossover": crossover,
+        "topology_results": topo_results,
+        "topology_crossover": topo_crossover,
+        "propagation": propagation,
     }
     path = os.environ.get("EDL_COORD_OUT", os.path.join(REPO, "BENCH_COORD.json"))
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
         fh.write("\n")
-    print(json.dumps({"wrote": path, "crossover": crossover}))
+    print(json.dumps({"wrote": path, "crossover": crossover,
+                      "topology_crossover": topo_crossover}))
     return out
 
 
+def smoke() -> int:
+    """<60 s sanity slice for `make verify`: both topologies at N=500 plus
+    a fast pull-vs-push propagation pair, written to a throwaway path.
+    Returns a nonzero exit code on implausible results; skips (0) when the
+    native toolchain is absent."""
+    from edl_tpu.coordinator.server import CoordinatorError, ensure_built
+
+    try:
+        ensure_built()
+    except (CoordinatorError, OSError) as exc:
+        print(f"bench-coord smoke: skipped (no native toolchain: {exc})")
+        return 0
+
+    os.environ["EDL_COORD_SECTIONS"] = '["topology", "propagation"]'
+    os.environ["EDL_COORD_SHARD_NS"] = "[500]"
+    os.environ["EDL_COORD_SECS"] = "0.8"
+    os.environ["EDL_COORD_WARMUP"] = "0.2"
+    os.environ["EDL_COORD_MAX_CONNS"] = "128"
+    os.environ["EDL_COORD_PROP_WORKERS"] = "50"
+    os.environ["EDL_COORD_PROP_PERIOD"] = "0.5"
+    os.environ.setdefault(
+        "EDL_COORD_OUT",
+        os.path.join(tempfile.gettempdir(), "bench-coord-smoke.json"))
+    out = main()
+
+    failures = []
+    for cell in out["topology_results"]:
+        if cell["beats"] <= 0:
+            failures.append(f"{cell['topology']}@{cell['n']}: no beats")
+        if cell["ops_per_sec"] <= 0:
+            failures.append(f"{cell['topology']}@{cell['n']}: no server ops")
+    prop = out["propagation"]
+    if not prop:
+        failures.append("propagation section missing")
+    else:
+        if prop["pull"]["discovered"] != prop["workers"]:
+            failures.append("pull arm lost workers")
+        if prop["push"]["discovered"] != prop["workers"]:
+            failures.append("push arm lost watchers")
+        # Push must beat the polling cadence by a wide margin even in a
+        # smoke slice; mean (not p99) keeps the assertion stable on a
+        # loaded 1-core host.
+        if prop["push"]["mean_ms"] >= prop["pull"]["mean_ms"]:
+            failures.append(
+                f"push no faster than pull ({prop['push']['mean_ms']} ms "
+                f"vs {prop['pull']['mean_ms']} ms)")
+    if failures:
+        print(json.dumps({"bench_coord_smoke": "FAIL", "failures": failures}))
+        return 1
+    print(json.dumps({"bench_coord_smoke": "ok"}))
+    return 0
+
+
 if __name__ == "__main__":
+    import sys
+    if "--smoke" in sys.argv[1:]:
+        raise SystemExit(smoke())
     main()

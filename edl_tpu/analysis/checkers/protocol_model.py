@@ -74,8 +74,7 @@ class ProtocolModelChecker:
     ) -> Iterator[Finding]:
         from edl_tpu.analysis.modelcheck import (
             ModelCheckError,
-            ckpt_plane_scripts,
-            default_scripts,
+            default_schedules,
             explore,
             load_state_effects,
         )
@@ -124,34 +123,30 @@ class ProtocolModelChecker:
             return  # exploration over a drifted spec only repeats the news
 
         fuzz = int(ctx.config.get("edl009_fuzz", 0))
+        violations = []
         try:
-            result = explore(
-                default_scripts(),
-                effects,
-                max_traces=int(ctx.config.get("edl009_max_traces", 20000)),
-                max_violations=MAX_VIOLATION_FINDINGS * 4,
-                fuzz_samples=fuzz,
-                fuzz_seed=int(ctx.config.get("edl009_fuzz_seed", 0)),
-            )
-            # Checkpoint-plane schedule (shard_put dedup replay, stale put,
-            # step-conditional drop) — explored separately so each schedule
-            # stays inside the interleaving budget; findings merge.
-            extra = explore(
-                ckpt_plane_scripts(),
-                effects,
-                max_traces=int(ctx.config.get("edl009_max_traces", 20000)),
-                max_violations=MAX_VIOLATION_FINDINGS * 4,
-                fuzz_samples=fuzz,
-                fuzz_seed=int(ctx.config.get("edl009_fuzz_seed", 0)),
-            )
-            result.traces += extra.traces
-            result.replays += extra.replays
-            result.violations.extend(extra.violations)
+            # The acceptance schedules (faulty base, checkpoint plane,
+            # watch/notify, redirect-during-watch) — each explored
+            # separately so every schedule stays inside the interleaving
+            # budget; findings merge.
+            for scripts, factory, endpoints in default_schedules():
+                result = explore(
+                    scripts,
+                    effects,
+                    coordinator_factory=factory,
+                    max_traces=int(
+                        ctx.config.get("edl009_max_traces", 20000)),
+                    max_violations=MAX_VIOLATION_FINDINGS * 4,
+                    fuzz_samples=fuzz,
+                    fuzz_seed=int(ctx.config.get("edl009_fuzz_seed", 0)),
+                    shard_endpoints=endpoints,
+                )
+                violations.extend(result.violations)
         except ModelCheckError as e:
             yield schema_finding(f"state_effects cannot drive the model: {e}")
             return
 
-        for v in result.violations[:MAX_VIOLATION_FINDINGS]:
+        for v in violations[:MAX_VIOLATION_FINDINGS]:
             yield Finding(
                 rule=self.rule, path=target_rel, line=1, col=0,
                 message=(
@@ -160,7 +155,7 @@ class ProtocolModelChecker:
                 ),
                 symbol=v.kind,
             )
-        overflow = len(result.violations) - MAX_VIOLATION_FINDINGS
+        overflow = len(violations) - MAX_VIOLATION_FINDINGS
         if overflow > 0:
             yield Finding(
                 rule=self.rule, path=target_rel, line=1, col=0,

@@ -54,9 +54,9 @@ DEFAULT_PREFIXES = ("edl_tpu/coordinator/", "edl_tpu/cli.py")
 #: fields every request may carry regardless of op: the client's envelope
 ENVELOPE_REQUEST = ("op", "token", "worker")
 
-#: ops the server refuses inside a batch frame (they park the connection
-#: or nest framing)
-NON_BATCHABLE = ("batch", "barrier", "sync")
+#: ops the server refuses inside a batch frame (they park the connection,
+#: nest framing, or — watch — bind an out-of-band push stream to the fd)
+NON_BATCHABLE = ("batch", "barrier", "sync", "watch")
 
 SCHEMA_VERSION = 1
 

@@ -33,9 +33,12 @@ exhaustive run at equal depth: both draw schedules from the same runnable
 sets, the walk just samples one branch per node.
 
 ``python -m edl_tpu.analysis.modelcheck`` runs the default bounded
-configuration (2 workers, 13 ops including ``batch``, one crash+restart,
-two duplicate deliveries) and exits 1 on any violation — the ``make
-modelcheck`` gate.
+configuration — four merged schedules: the 2-worker faulty base (13 ops
+including ``batch``, one crash+restart, two duplicate deliveries), the
+checkpoint-plane ops, a watch/notify schedule (resume-cursor replay,
+duplicate notification delivery via a stale re-subscribe), and a
+redirect-during-watch schedule against a sharded root — and exits 1 on any
+violation: the ``make modelcheck`` gate.
 """
 
 from __future__ import annotations
@@ -46,9 +49,12 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-#: ops a ``call_batch`` frame refuses (they park or nest framing);
-#: mirrored from the wire protocol, used by the composite handler.
-_NON_BATCHABLE = ("batch", "barrier", "sync")
+from edl_tpu.coordinator.sharding import shard_of
+
+#: ops a ``call_batch`` frame refuses (they park, nest framing, or bind an
+#: out-of-band push stream to the connection — ``watch``); mirrored from the
+#: wire protocol, used by the composite handler.
+_NON_BATCHABLE = ("batch", "barrier", "sync", "watch")
 
 #: sentinel request-field value: resolved at issue time to the task named in
 #: the issuing worker's most recent acquire reply (each side — model and
@@ -140,10 +146,11 @@ class ProtocolModel:
 
     _KNOWN_TAGS = {
         "epoch", "lease", "dedup", "kv", "queue", "membership", "parks",
-        "composite", "shard",
+        "composite", "shard", "watch", "routing",
     }
 
-    def __init__(self, effects: Dict[str, Dict[str, Any]]):
+    def __init__(self, effects: Dict[str, Dict[str, Any]],
+                 shard_endpoints: Optional[Sequence[str]] = None):
         for op, tags in effects.items():
             unknown = set(tags) - self._KNOWN_TAGS
             if unknown:
@@ -152,6 +159,9 @@ class ProtocolModel:
                     f"{sorted(unknown)}"
                 )
         self.effects = effects
+        # Sharded-ROOT mode (native --shards): with endpoints configured,
+        # every keyspace op answers a redirect instead of being served.
+        self.shard_endpoints: List[str] = list(shard_endpoints or [])
         self.epoch = 0
         self.members: Dict[str, int] = {}  # name -> rank
         self.next_rank = 0
@@ -166,10 +176,13 @@ class ProtocolModel:
         # Checkpoint plane: owner -> {step, chunks, nbytes, group, data}.
         self.shards: Dict[str, Dict[str, Any]] = {}
         self.shard_put_seen: set = set()
+        # Watch subscriptions: worker -> pending notification frames.
+        self.watch_queues: Dict[str, List[Dict[str, Any]]] = {}
 
     def copy(self) -> "ProtocolModel":
         m = ProtocolModel.__new__(ProtocolModel)
         m.effects = self.effects
+        m.shard_endpoints = list(self.shard_endpoints)
         m.epoch = self.epoch
         m.members = dict(self.members)
         m.next_rank = self.next_rank
@@ -192,6 +205,9 @@ class ProtocolModel:
             for owner, b in self.shards.items()
         }
         m.shard_put_seen = set(self.shard_put_seen)
+        m.watch_queues = {
+            w: [dict(f) for f in q] for w, q in self.watch_queues.items()
+        }
         return m
 
     # Every handler returns (reply_prediction | None-if-parked, released)
@@ -212,6 +228,27 @@ class ProtocolModel:
         rank = self.members.get(worker, -1)
         return {"ok": True, "rank": rank, "epoch": self.epoch,
                 "world": len(self.members)}
+
+    def _redirect(self, key: Any) -> Optional[Dict[str, Any]]:
+        """Redirect prediction for a keyspace op on a sharded ROOT; None on
+        a plain coordinator. Mirrors the twin's ``redirect_for`` (which
+        mirrors the native ``redirect_reply``), including the epoch stamp
+        and the answer-before-validation placement."""
+        if not self.shard_endpoints:
+            return None
+        s = shard_of(str(key), len(self.shard_endpoints))
+        return {"ok": False, "error": "wrong shard",
+                "redirect": self.shard_endpoints[s], "shard": s,
+                "epoch": self.epoch}
+
+    def _notify_frame(self, e: int) -> Dict[str, Any]:
+        return {"ok": True, "notify": "epoch", "epoch": int(e),
+                "cursor": int(e), "world": len(self.members)}
+
+    def _notify_watchers(self) -> None:
+        """Epoch moved: one notification frame per live subscription."""
+        for q in self.watch_queues.values():
+            q.append(self._notify_frame(self.epoch))
 
     def _requeue_worker_leases(self, worker: str) -> None:
         stale = [t for t, w in self.leased.items() if w == worker]
@@ -240,6 +277,7 @@ class ProtocolModel:
             self.next_rank += 1
             if tags.get("epoch") == "bump_on_join":
                 self.epoch += 1
+                self._notify_watchers()
                 released = self._release_sync_on_epoch_change()
         return self._membership_reply(worker), released
 
@@ -262,6 +300,7 @@ class ProtocolModel:
             self.next_rank = len(self.members)
             if self.effects["leave"].get("epoch") == "bump_on_drop":
                 self.epoch += 1
+                self._notify_watchers()
             self._requeue_worker_leases(target)
             self.acquire_cache.pop(target, None)
             released = self._release_sync_on_epoch_change()
@@ -276,6 +315,10 @@ class ProtocolModel:
         return {"ok": True, "pong": True, "epoch": self.epoch}, []
 
     def _op_add_tasks(self, worker: str, fields: Dict[str, Any]):
+        tasks = fields.get("tasks") or []
+        r = self._redirect(str(tasks[0]) if tasks else "")
+        if r:
+            return r, []
         added = 0
         for t in fields.get("tasks", []):
             if t in self.done or t in self.leased or t in self.todo:
@@ -286,6 +329,9 @@ class ProtocolModel:
                  "epoch": self.epoch}, [])
 
     def _op_acquire_task(self, worker: str, fields: Dict[str, Any]):
+        r = self._redirect(worker)
+        if r:
+            return r, []
         req_id = fields.get("req_id")
         if req_id and self.effects["acquire_task"].get("dedup") == "req_id":
             cached = self.acquire_cache.get(worker)
@@ -305,6 +351,9 @@ class ProtocolModel:
 
     def _op_complete_task(self, worker: str, fields: Dict[str, Any]):
         task = fields.get("task")
+        r = self._redirect(task)
+        if r:
+            return r, []
         if task in self.done:
             return ({"ok": True, "duplicate": True, "done": len(self.done),
                      "queued": len(self.todo), "epoch": self.epoch}, [])
@@ -327,6 +376,9 @@ class ProtocolModel:
 
     def _op_fail_task(self, worker: str, fields: Dict[str, Any]):
         task = fields.get("task")
+        r = self._redirect(task)
+        if r:
+            return r, []
         if task not in self.leased:
             return ({"ok": False, "error": "not leased",
                      "epoch": self.epoch}, [])
@@ -339,6 +391,9 @@ class ProtocolModel:
 
     def _op_kv_put(self, worker: str, fields: Dict[str, Any]):
         key = fields.get("key")
+        r = self._redirect(key or "")
+        if r:
+            return r, []
         if not key:
             return ({"ok": False, "error": "key required",
                      "epoch": self.epoch}, [])
@@ -346,15 +401,24 @@ class ProtocolModel:
         return {"ok": True, "epoch": self.epoch}, []
 
     def _op_kv_get(self, worker: str, fields: Dict[str, Any]):
+        r = self._redirect(fields.get("key") or "")
+        if r:
+            return r, []
         return ({"ok": True, "value": self.kv.get(fields.get("key")),
                  "epoch": self.epoch}, [])
 
     def _op_kv_del(self, worker: str, fields: Dict[str, Any]):
+        r = self._redirect(fields.get("key") or "")
+        if r:
+            return r, []
         self.kv.pop(fields.get("key"), None)
         return {"ok": True, "epoch": self.epoch}, []
 
     def _op_kv_incr(self, worker: str, fields: Dict[str, Any]):
         key = fields.get("key", "")
+        r = self._redirect(key)
+        if r:
+            return r, []
         if not key:
             return ({"ok": False, "error": "key required",
                      "epoch": self.epoch}, [])
@@ -377,6 +441,9 @@ class ProtocolModel:
 
     def _op_shard_put(self, worker: str, fields: Dict[str, Any]):
         owner = fields.get("owner", "")
+        r = self._redirect(owner)
+        if r:
+            return r, []
         step = int(fields.get("step", -1))
         chunk = int(fields.get("chunk", -1))
         chunks = int(fields.get("chunks", 0))
@@ -413,6 +480,9 @@ class ProtocolModel:
 
     def _op_shard_get(self, worker: str, fields: Dict[str, Any]):
         owner = fields.get("owner", "")
+        r = self._redirect(owner)
+        if r:
+            return r, []
         step = int(fields.get("step", -1))
         chunk = int(fields.get("chunk", 0))
         blob = self.shards.get(owner)
@@ -427,6 +497,9 @@ class ProtocolModel:
                  "chunks": blob["chunks"], "epoch": self.epoch}, [])
 
     def _op_shard_meta(self, worker: str, fields: Dict[str, Any]):
+        r = self._redirect(fields.get("owner", ""))
+        if r:
+            return r, []
         blob = self.shards.get(fields.get("owner", ""))
         if blob is None or blob["step"] < 0:
             return ({"ok": True, "found": False, "step": -1, "chunks": 0,
@@ -440,6 +513,9 @@ class ProtocolModel:
 
     def _op_shard_drop(self, worker: str, fields: Dict[str, Any]):
         owner = fields.get("owner", "")
+        r = self._redirect(owner)
+        if r:
+            return r, []
         step = int(fields.get("step", -1))
         blob = self.shards.get(owner)
         dropped = False
@@ -450,6 +526,7 @@ class ProtocolModel:
 
     def _op_bump_epoch(self, worker: str, fields: Dict[str, Any]):
         self.epoch += 1
+        self._notify_watchers()
         released = self._release_sync_on_epoch_change()
         return {"ok": True, "epoch": self.epoch}, released
 
@@ -457,6 +534,40 @@ class ProtocolModel:
         return ({"ok": True, "epoch": self.epoch,
                  "world": len(self.members), "queued": len(self.todo),
                  "leased": len(self.leased), "done": len(self.done)}, [])
+
+    # Watch/notify ops (push-based epoch discovery). The twin has no socket
+    # to push to, so delivery is modeled the way the shim serves it: a
+    # subscribe queues replayed frames for every epoch in (cursor, current],
+    # epoch bumps append live frames, and ``watch`` with take=True drains
+    # one frame (the in-process stand-in for the wire server's unsolicited
+    # push). Frames carry the epoch being ANNOUNCED, which may be historical.
+
+    def _op_watch(self, worker: str, fields: Dict[str, Any]):
+        if fields.get("take"):
+            q = self.watch_queues.get(worker)
+            if not q:
+                return ({"ok": True, "notify": None, "cursor": self.epoch,
+                         "world": len(self.members),
+                         "epoch": self.epoch}, [])
+            return dict(q.pop(0)), []
+        q = self.watch_queues.setdefault(worker, [])
+        cursor = int(fields.get("cursor", -1))
+        if cursor >= 0:
+            for e in range(cursor + 1, self.epoch + 1):
+                q.append(self._notify_frame(e))
+        return ({"ok": True, "watch": True, "cursor": self.epoch,
+                 "epoch": self.epoch}, [])
+
+    def _op_watch_cancel(self, worker: str, fields: Dict[str, Any]):
+        cancelled = worker in self.watch_queues
+        self.watch_queues.pop(worker, None)
+        return {"ok": True, "cancelled": cancelled, "epoch": self.epoch}, []
+
+    def _op_shard_map(self, worker: str, fields: Dict[str, Any]):
+        return ({"ok": True, "root": bool(self.shard_endpoints),
+                 "nshards": len(self.shard_endpoints),
+                 "shards": list(self.shard_endpoints), "shard_index": -1,
+                 "epoch": self.epoch}, [])
 
     def _op_batch(self, worker: str, fields: Dict[str, Any]):
         if not self.effects["batch"].get("composite"):
@@ -716,8 +827,11 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
                             f"{key}={want!r}, oracle replied "
                             f"{(hs or {}).get(key, '<absent>')!r}",
                             rendered))
-        # invariant: per-stream epoch monotonicity
-        if "epoch" in reply:
+        # invariant: per-stream epoch monotonicity. Notification frames are
+        # exempt: their "epoch" names the (possibly historical) epoch being
+        # announced — on the wire they ride a dedicated watch connection,
+        # not the request/reply stream the invariant is defined over.
+        if "epoch" in reply and not reply.get("notify"):
             ep = int(reply["epoch"])
             if ep < last_epoch.get(ev.worker, 0):
                 violations.append(Violation(
@@ -837,10 +951,13 @@ def explore(
     fuzz_samples: int = 0,
     fuzz_seed: int = 0,
     replay: bool = True,
+    shard_endpoints: Optional[Sequence[str]] = None,
 ) -> ModelCheckResult:
     """Enumerate interleavings of ``scripts`` (exhaustive DFS, or a seeded
     random walk when ``fuzz_samples > 0``), model-check each, and replay
-    completed traces against the oracle coordinator."""
+    completed traces against the oracle coordinator. ``shard_endpoints``
+    puts the MODEL in sharded-root mode — pair it with a factory that
+    builds the oracle with the same endpoints."""
     factory = coordinator_factory or _default_coordinator_factory
     result = ModelCheckResult()
 
@@ -872,7 +989,8 @@ def explore(
         for _ in range(fuzz_samples):
             if not budget_left():
                 break
-            state = _TraceState(scripts, ProtocolModel(effects))
+            state = _TraceState(
+                scripts, ProtocolModel(effects, shard_endpoints))
             while True:
                 workers = state.runnable()
                 if not workers:
@@ -899,7 +1017,7 @@ def explore(
             if not budget_left():
                 return
 
-    dfs(_TraceState(scripts, ProtocolModel(effects)))
+    dfs(_TraceState(scripts, ProtocolModel(effects, shard_endpoints)))
     return result
 
 
@@ -967,6 +1085,93 @@ def ckpt_plane_scripts() -> Dict[str, List[ScriptOp]]:
     return {"w0": w0, "w1": w1}
 
 
+#: fake shard endpoints driving the redirect schedules — never dialed; the
+#: sharded root (model AND twin) only hashes keys against them (FNV-1a).
+SHARD_ENDPOINTS = ["10.0.0.1:7164", "10.0.0.2:7164"]
+
+
+def watch_scripts() -> Dict[str, List[ScriptOp]]:
+    """Watch/notify schedule: subscribe with a resume cursor, epoch bumps
+    from joins and an explicit bump, frame drains interleaved with the
+    bumps, a duplicate re-subscribe at a stale cursor (at-least-once
+    delivery replays already-announced epochs — the model must predict the
+    duplicates exactly), and a cancel. Runs against the plain twin."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("watch", cursor=0, worker="w0"),
+        mk("watch", take=True, worker="w0"),
+        mk("bump_epoch"),
+        mk("watch", take=True, worker="w0"),
+        mk("watch", note="dup", cursor=0, worker="w0"),
+        mk("watch", take=True, worker="w0"),
+        mk("watch_cancel", worker="w0"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("shard_map"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def watch_redirect_scripts() -> Dict[str, List[ScriptOp]]:
+    """Redirect-during-watch schedule against a sharded ROOT
+    (``SHARD_ENDPOINTS``): every keyspace op answers a redirect computed by
+    key hash (never served), while membership, epoch bumps, and the watch
+    stream stay root-local — notifications keep flowing to a subscriber
+    whose data ops are being bounced to shard servers."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("watch", cursor=0, worker="w0"),
+        mk("kv_put", key="alpha", value="1"),
+        mk("bump_epoch"),
+        mk("watch", take=True, worker="w0"),
+        mk("shard_map"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("acquire_task", req_id="w1-a1", worker="w1"),
+        mk("add_tasks", tasks=["t0"]),
+        mk("kv_get", key="beta"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def _sharded_root_factory():
+    from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+    return InProcessCoordinator(task_lease_sec=1e9, heartbeat_ttl_sec=1e9,
+                                shard_endpoints=list(SHARD_ENDPOINTS))
+
+
+def default_schedules(
+    coordinator_factory: Optional[CoordinatorFactory] = None,
+) -> List[Tuple[Dict[str, List[ScriptOp]],
+                Optional[CoordinatorFactory],
+                Optional[List[str]]]]:
+    """The acceptance schedules as (scripts, factory, shard_endpoints)
+    rows — explored separately so each stays inside the interleaving
+    budget; results merge. With a caller-supplied ``coordinator_factory``
+    (the broken-twin tests) the redirect schedule runs UNSHARDED against
+    that factory: routing is only modeled when we also control the oracle's
+    shard configuration."""
+    rows: List[Tuple[Dict[str, List[ScriptOp]],
+                     Optional[CoordinatorFactory],
+                     Optional[List[str]]]] = [
+        (default_scripts(), coordinator_factory, None),
+        (ckpt_plane_scripts(), coordinator_factory, None),
+        (watch_scripts(), coordinator_factory, None),
+    ]
+    if coordinator_factory is None:
+        rows.append((watch_redirect_scripts(), _sharded_root_factory,
+                     list(SHARD_ENDPOINTS)))
+    else:
+        rows.append((watch_redirect_scripts(), coordinator_factory, None))
+    return rows
+
+
 def load_state_effects(root: str, schema_rel: str = "protocol_schema.json"):
     """(state_effects dict or None, declared op set or None, error string)."""
     path = os.path.join(root, schema_rel)
@@ -1001,23 +1206,18 @@ def run_default(
         effects, _ops, err = load_state_effects(root)
         if err:
             raise ModelCheckError(err)
-    result = explore(
-        default_scripts(), effects,
-        coordinator_factory=coordinator_factory,
-        fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
-        max_traces=max_traces, max_violations=max_violations,
-    )
-    # Second schedule: the checkpoint-plane ops (separate so each schedule's
-    # interleaving count stays inside the budget; results are merged).
-    extra = explore(
-        ckpt_plane_scripts(), effects,
-        coordinator_factory=coordinator_factory,
-        fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
-        max_traces=max_traces, max_violations=max_violations,
-    )
-    result.traces += extra.traces
-    result.replays += extra.replays
-    result.violations.extend(extra.violations)
+    result = ModelCheckResult()
+    for scripts, factory, endpoints in default_schedules(coordinator_factory):
+        extra = explore(
+            scripts, effects,
+            coordinator_factory=factory,
+            fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
+            max_traces=max_traces, max_violations=max_violations,
+            shard_endpoints=endpoints,
+        )
+        result.traces += extra.traces
+        result.replays += extra.replays
+        result.violations.extend(extra.violations)
     return result
 
 

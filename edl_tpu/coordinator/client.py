@@ -17,6 +17,7 @@ import uuid
 from typing import Dict, List, Optional
 
 from edl_tpu.coordinator.retry import DEFAULT_RETRY, RetryPolicy
+from edl_tpu.coordinator.sharding import ShardMap, partition_tasks, route_key
 from edl_tpu.obs.metrics import get_registry
 
 # Process-wide client telemetry (all CoordinatorClient instances in this
@@ -43,6 +44,16 @@ _M_CALL_LATENCY = _REG.histogram(
     "edl_client_call_latency_seconds",
     "coordinator RPC round-trip latency (excludes ops parked server-side: "
     "barrier/sync wait time is rendezvous, not transport)",
+)
+_M_SHARD_REDIRECTS = _REG.counter(
+    "edl_client_shard_redirects_total",
+    "redirect replies observed (root routing a keyspace op, or a stale "
+    "shard map sending an op to the wrong shard)",
+)
+_M_SHARD_MAP_REFRESHES = _REG.counter(
+    "edl_client_shard_map_refreshes_total",
+    "shard_map re-resolutions (first redirect, stale-map invalidation, or "
+    "reconnect after a shard endpoint became unreachable)",
 )
 #: parked ops: their round-trip time measures rendezvous latency, which
 #: would swamp the transport histogram with multi-second waits.
@@ -144,6 +155,11 @@ class CoordinatorClient:
         self._last_piggyback = 0.0
         self._sock: Optional[socket.socket] = None
         self._buf = b""
+        #: sharded-plane routing state, learned lazily from the first
+        #: redirect reply — a plain single-process coordinator never sends
+        #: one, so unsharded deployments never pay a shard_map round-trip.
+        self._shard_map: Optional[ShardMap] = None
+        self._shard_clients: Dict[int, "CoordinatorClient"] = {}
         #: per-client nonce namespaces dedup ids (req_id/op_id) so a fresh
         #: process reusing a worker name can never hit a predecessor's
         #: cached replies or persisted kv_incr markers.
@@ -177,6 +193,7 @@ class CoordinatorClient:
         )
 
     def close(self) -> None:
+        self._drop_shard_clients()
         with self._lock:
             if self._sock is not None:
                 try:
@@ -200,7 +217,34 @@ class CoordinatorClient:
         every op — mutating ops carry dedup ids (``req_id``/``op_id``) or
         are idempotent server-side (``complete_task``). Auth rejections
         and reply timeouts propagate immediately.
+
+        Against a sharded control plane, keyspace ops are routed to their
+        owning shard via the cached shard map (learned from the root on the
+        first redirect reply; see ``sharding.route_key``). Single-process
+        coordinators never redirect, so the unsharded path is unchanged.
         """
+        smap = self._shard_map
+        if smap is not None and smap.nshards > 0:
+            if op == "add_tasks":
+                return self._sharded_add_tasks(timeout, fields)
+            if op == "acquire_task":
+                return self._sharded_acquire(timeout, fields)
+            key = route_key(op, fields)
+            if key is not None:
+                return self._shard_call(op, timeout, fields, key)
+        reply = self._direct_call(op, timeout, fields)
+        if self._is_redirect(reply):
+            # First contact with a sharded root: learn the map, re-route.
+            _M_SHARD_REDIRECTS.inc()
+            self._refresh_shard_map()
+            if self._shard_map is not None and self._shard_map.nshards > 0:
+                return self.call(op, timeout=timeout, **fields)
+        return reply
+
+    def _direct_call(self, op: str, timeout: Optional[float],
+                     fields: Dict) -> Dict:
+        """The pre-sharding call body: piggyback check + retry loop over one
+        request/reply transaction on THIS client's own connection."""
         if self._piggyback_due(op, fields):
             return self._call_with_piggyback(op, timeout, fields)
         if self.retry is None:
@@ -221,6 +265,196 @@ class CoordinatorClient:
                 _M_RETRIES.inc()
                 time.sleep(delay)
 
+    # -- shard routing ---------------------------------------------------------
+
+    @staticmethod
+    def _is_redirect(reply) -> bool:
+        return (isinstance(reply, dict) and not reply.get("ok")
+                and "redirect" in reply)
+
+    def _shard_call(self, op: str, timeout: Optional[float], fields: Dict,
+                    key: str) -> Dict:
+        """Route one keyspace op to the shard owning ``key``.
+
+        A redirect reply or an unreachable shard endpoint invalidates the
+        cached map and re-resolves it from the root (bounded, with the
+        retry policy's backoff) instead of hammering the stale address to
+        deadline exhaustion; the op is then re-routed against the fresh
+        map. Redirect ping-pong (a genuinely disagreeing root) is capped —
+        the last redirect reply is returned rather than looping forever.
+        """
+        redirects = 0
+        refreshes = 0
+        while True:
+            smap = self._shard_map
+            if smap is None or smap.nshards == 0:
+                # Routing got disabled mid-flight (root says unsharded).
+                return self._direct_call(op, timeout, fields)
+            slot = smap.slot_for(key)
+            try:
+                reply = self._shard_client(slot)._direct_call(
+                    op, timeout, fields)
+            except (CoordinatorAuthError, CoordinatorTimeout):
+                raise
+            except CoordinatorUnreachable:
+                # Stale endpoint (shard moved or restarting): re-resolve
+                # the map rather than retrying the dead address.
+                if refreshes >= 3:
+                    raise
+                refreshes += 1
+                self._drop_shard_clients()
+                self._refresh_shard_map()
+                continue
+            if self._is_redirect(reply):
+                _M_SHARD_REDIRECTS.inc()
+                redirects += 1
+                if redirects > 4:
+                    return reply
+                self._refresh_shard_map()
+                continue
+            return reply
+
+    def _shard_client(self, slot: int) -> "CoordinatorClient":
+        with self._lock:
+            sub = self._shard_clients.get(slot)
+            if sub is not None:
+                return sub
+        endpoint = self._shard_map.shards[slot]
+        host, _, port = endpoint.rpartition(":")
+        # Fail fast on a dead shard (the slot loop's refresh path is the
+        # retry mechanism) — no per-sub-client retry policy, short dial.
+        sub = CoordinatorClient(
+            host=host or "127.0.0.1", port=int(port), worker=self.worker,
+            connect_timeout=min(2.0, self.connect_timeout),
+            token=self.token, retry=None, piggyback_heartbeat=0.0)
+        with self._lock:
+            existing = self._shard_clients.get(slot)
+            if existing is not None:
+                sub.close()
+                return existing
+            self._shard_clients[slot] = sub
+        return sub
+
+    def _drop_shard_clients(self) -> None:
+        with self._lock:
+            subs, self._shard_clients = self._shard_clients, {}
+        for sub in subs.values():
+            try:
+                sub.close()
+            except OSError:
+                pass
+
+    def _refresh_shard_map(self) -> None:
+        """Bounded shard-map re-resolution against the root.
+
+        Called on the first redirect, on a redirect proving the cached map
+        stale, and when a cached shard endpoint stops answering. At most a
+        few attempts with the retry policy's backoff between them — the
+        root being down is a full control-plane outage and surfaces as
+        CoordinatorUnreachable like any other root call.
+        """
+        _M_SHARD_MAP_REFRESHES.inc()
+        sleeps = (self.retry or DEFAULT_RETRY).sleeps()
+        last_err: Optional[Exception] = None
+        for _attempt in range(4):
+            try:
+                reply = self._direct_call("shard_map", None, {})
+            except CoordinatorUnreachable as e:
+                last_err = e
+                time.sleep(next(sleeps))
+                continue
+            if reply.get("ok") and reply.get("root") and reply.get("shards"):
+                new = ShardMap([str(s) for s in reply["shards"]])
+                with self._lock:
+                    old = self._shard_map
+                    self._shard_map = new
+                if old is None or old.shards != new.shards:
+                    self._drop_shard_clients()
+                return
+            # The endpoint answers but is not a sharded root: disable
+            # routing (covers a root replaced by a plain coordinator).
+            with self._lock:
+                self._shard_map = None
+            self._drop_shard_clients()
+            return
+        raise CoordinatorUnreachable(
+            f"shard_map refresh failed against root "
+            f"{self.host}:{self.port}: {last_err}")
+
+    def _sharded_add_tasks(self, timeout: Optional[float],
+                           fields: Dict) -> Dict:
+        """Partition an add_tasks batch by owning shard client-side (tasks
+        are hashed by name) and merge the per-shard replies."""
+        tasks = fields.get("tasks")
+        if not isinstance(tasks, list) or not tasks:
+            # Let one shard produce the canonical error/empty reply.
+            return self._shard_call("add_tasks", timeout, fields, "")
+        parts = partition_tasks([str(t) for t in tasks],
+                                self._shard_map.nshards)
+        added = 0
+        queued = 0
+        last: Dict = {}
+        for _slot, chunk in sorted(parts.items()):
+            sub_fields = dict(fields)
+            sub_fields["tasks"] = chunk
+            reply = self._shard_call("add_tasks", timeout, sub_fields,
+                                     chunk[0])
+            if not reply.get("ok"):
+                return reply
+            last = reply
+            added += int(reply.get("added", 0))
+            queued += int(reply.get("queued", 0))
+        merged = dict(last)
+        merged["added"] = added
+        merged["queued"] = queued
+        return merged
+
+    def _sharded_acquire(self, timeout: Optional[float],
+                         fields: Dict) -> Dict:
+        """Acquire from the sharded task space: rotate over every shard
+        starting at the worker's stable home slot, returning the first
+        grant. Drained only when EVERY shard reports exhausted."""
+        smap = self._shard_map
+        n = smap.nshards
+        start = smap.slot_for(str(fields.get("worker") or self.worker or ""))
+        exhausted = True
+        last: Dict = {}
+        for i in range(n):
+            slot = (start + i) % n
+            reply = self._shard_call_slot("acquire_task", timeout, fields,
+                                          slot)
+            if not reply.get("ok"):
+                return reply
+            if reply.get("task") is not None:
+                return reply
+            last = reply
+            exhausted = exhausted and bool(reply.get("exhausted"))
+        merged = dict(last) if last else {"ok": True, "task": None}
+        merged["task"] = None
+        merged["exhausted"] = exhausted
+        return merged
+
+    def _shard_call_slot(self, op: str, timeout: Optional[float],
+                         fields: Dict, slot: int) -> Dict:
+        """Like _shard_call but targeting an explicit slot (acquire's
+        rotation) — same refresh-on-unreachable behavior."""
+        refreshes = 0
+        while True:
+            smap = self._shard_map
+            if smap is None or smap.nshards == 0:
+                return self._direct_call(op, timeout, fields)
+            try:
+                return self._shard_client(slot % smap.nshards)._direct_call(
+                    op, timeout, fields)
+            except (CoordinatorAuthError, CoordinatorTimeout):
+                raise
+            except CoordinatorUnreachable:
+                if refreshes >= 3:
+                    raise
+                refreshes += 1
+                self._drop_shard_clients()
+                self._refresh_shard_map()
+
     def call_batch(self, ops: List, timeout: Optional[float] = None) -> List[Dict]:
         """Send many sub-ops in ONE frame; returns per-sub-op replies.
 
@@ -233,22 +467,90 @@ class CoordinatorClient:
         ``barrier``/``sync`` are not batchable (their replies are parked
         server-side and cannot be threaded into a positional reply array).
         """
-        encoded = []
+        reqs = []
         for item in ops:
             if isinstance(item, dict):
                 req = dict(item)
             else:
                 op, fields = item
                 req = {"op": op, **fields}
-            encoded.append(json.dumps(req, ensure_ascii=False))
+            reqs.append(req)
+        smap = self._shard_map
+        if smap is not None and smap.nshards > 0:
+            return self._call_batch_sharded(reqs, timeout)
+        encoded = [json.dumps(r, ensure_ascii=False) for r in reqs]
         _M_BATCH_FRAMES.inc()
-        reply = self.call("batch", timeout=timeout, ops=encoded)
+        reply = self._direct_call("batch", timeout, {"ops": encoded})
         if not reply.get("ok"):
             raise CoordinatorError(f"batch frame rejected: {reply.get('error')}")
         subs = [json.loads(line) for line in reply.get("replies", [])]
+        if any(self._is_redirect(s) for s in subs):
+            # The root redirected keyspace sub-ops: learn the shard map and
+            # re-dispatch the whole frame split by destination.
+            _M_SHARD_REDIRECTS.inc()
+            self._refresh_shard_map()
+            if self._shard_map is not None and self._shard_map.nshards > 0:
+                return self._call_batch_sharded(reqs, timeout)
         for sub in subs:
             self._note_reply(sub)
         return subs
+
+    def _call_batch_sharded(self, reqs: List[Dict],
+                            timeout: Optional[float]) -> List[Dict]:
+        """Split one logical batch by destination (root vs owning shard),
+        send one frame per destination, and reassemble replies positionally.
+        An add_tasks sub-op whose tasks span shards is executed via the
+        routed single-op path and spliced back into its position."""
+        smap = self._shard_map
+        groups: Dict[int, List] = {}  # dest slot (-1 = root) -> [(pos, req)]
+        singles: List = []  # (pos, req) for multi-shard add_tasks
+        for pos, req in enumerate(reqs):
+            op = req.get("op", "")
+            if op == "add_tasks" and isinstance(req.get("tasks"), list):
+                parts = partition_tasks([str(t) for t in req["tasks"]],
+                                        smap.nshards)
+                if len(parts) > 1:
+                    singles.append((pos, req))
+                    continue
+                slot = next(iter(parts)) if parts else 0
+                groups.setdefault(slot, []).append((pos, req))
+                continue
+            key = route_key(op, req)
+            dest = -1 if key is None else smap.slot_for(key)
+            groups.setdefault(dest, []).append((pos, req))
+        out: List[Optional[Dict]] = [None] * len(reqs)
+        for dest, items in sorted(groups.items()):
+            encoded = [json.dumps(r, ensure_ascii=False) for _, r in items]
+            _M_BATCH_FRAMES.inc()
+            if dest < 0:
+                frame = self._direct_call("batch", timeout, {"ops": encoded})
+            else:
+                frame = self._shard_call_slot("batch", timeout,
+                                              {"ops": encoded}, dest)
+            if not frame.get("ok"):
+                raise CoordinatorError(
+                    f"batch frame rejected: {frame.get('error')}")
+            sub_replies = [json.loads(line)
+                           for line in frame.get("replies", [])]
+            for (pos, req), sub in zip(items, sub_replies):
+                if self._is_redirect(sub):
+                    # Stale map for this sub-op: refresh and re-route it
+                    # individually (keeps the frame's positional contract).
+                    _M_SHARD_REDIRECTS.inc()
+                    self._refresh_shard_map()
+                    fields = {k: v for k, v in req.items() if k != "op"}
+                    sub = self.call(req.get("op", ""), timeout=timeout,
+                                    **fields)
+                out[pos] = sub
+                if dest < 0:
+                    # Only root replies feed epoch/membership observation:
+                    # shard processes don't see membership, so their epoch
+                    # stamps (always 0) must not clobber the real one.
+                    self._note_reply(sub)
+        for pos, req in singles:
+            fields = {k: v for k, v in req.items() if k != "op"}
+            out[pos] = self.call(req.get("op", ""), timeout=timeout, **fields)
+        return out  # type: ignore[return-value]
 
     #: ops a due heartbeat may NOT ride on: frames/parked ops (reply shape),
     #: and membership ops whose own semantics a heartbeat would perturb.
@@ -532,6 +834,12 @@ class CoordinatorClient:
 
     def status(self) -> Dict:
         return self.call("status")
+
+    def shard_map(self) -> Dict:
+        """The control plane's partition layout as the root reports it:
+        {root: bool, nshards, shards: [host:port...], shard_index}. A plain
+        single-process coordinator answers root=False, nshards=0."""
+        return self.call("shard_map")
 
     def ping(self) -> bool:
         try:

@@ -18,11 +18,14 @@ import uuid
 from collections import deque
 from typing import Dict, List, Optional, Set
 
+from edl_tpu.coordinator.sharding import shard_of
+
 
 class InProcessCoordinator:
     def __init__(self, task_lease_sec: float = 16.0,
                  heartbeat_ttl_sec: float = 10.0,
-                 auth_token: Optional[str] = None):
+                 auth_token: Optional[str] = None,
+                 shard_endpoints: Optional[List[str]] = None):
         self.task_lease_sec = task_lease_sec
         self.heartbeat_ttl_sec = heartbeat_ttl_sec
         #: per-job shared secret, same contract as the native binary's
@@ -54,6 +57,16 @@ class InProcessCoordinator:
         self._shards: Dict[str, Dict] = {}
         self._shard_put_seen: Set[str] = set()
         self._shard_put_order: deque = deque()
+        # Sharded-root twin (native --shards): with endpoints configured,
+        # every keyspace op answers a redirect instead of being served —
+        # EDL009 drives redirect-during-watch schedules through this.
+        self._shard_endpoints: List[str] = list(shard_endpoints or [])
+        self._shard_index = -1
+        self._num_shards = 0
+        # Watch subscriptions (native parity, worker-keyed instead of
+        # fd-keyed): pending notification frames per subscriber, drained by
+        # the shim's watch take path the way the wire server pushes them.
+        self._watch_queues: Dict[str, deque] = {}
         # Test-only mutation hook: EDL009's model checker flips this on a
         # deliberately-broken twin to prove a dedup regression is caught.
         # Never set outside tests.
@@ -89,6 +102,7 @@ class InProcessCoordinator:
             m["rank"] = r
         self._next_rank = len(self._members)
         self._epoch += 1
+        self._notify_watchers()
         self._requeue_worker_leases(name)
         self._acquire_cache.pop(name, None)
         self._release_sync()
@@ -133,6 +147,7 @@ class InProcessCoordinator:
                 }
                 self._next_rank += 1
                 self._epoch += 1
+                self._notify_watchers()
                 self._release_sync()
             else:
                 self._members[worker]["last_heartbeat"] = time.monotonic()
@@ -324,6 +339,7 @@ class InProcessCoordinator:
         parked sync waiters resync so workers observe a rescale immediately."""
         with self._barrier_cv:
             self._epoch += 1
+            self._notify_watchers()
             self._release_sync()
             return {"ok": True, "epoch": self._epoch}
 
@@ -455,6 +471,89 @@ class InProcessCoordinator:
                 del self._shards[owner]
                 dropped = True
             return {"ok": True, "dropped": dropped}
+
+    # -- push notifications (native parity: op_watch / push_notify) ------------
+
+    def _notify_frame(self, e: int) -> Dict:
+        return {"ok": True, "notify": "epoch", "epoch": int(e),
+                "cursor": int(e), "world": len(self._members)}
+
+    def _notify_watchers(self) -> None:
+        """Epoch moved: queue one notification frame per subscription (the
+        wire server pushes the same frame to every watcher fd)."""
+        for q in self._watch_queues.values():
+            q.append(self._notify_frame(self._epoch))
+
+    def watch(self, worker: str, cursor: int = -1) -> Dict:
+        """Subscribe ``worker`` to epoch-change notifications. cursor >= 0
+        resumes after a reconnect: every epoch in (cursor, current] is
+        queued exactly once, in order, before the ack — native parity with
+        op_watch's deferred replay."""
+        with self._lock:
+            self._tick()
+            q = self._watch_queues.setdefault(worker or "", deque())
+            if cursor >= 0:
+                for e in range(int(cursor) + 1, self._epoch + 1):
+                    q.append(self._notify_frame(e))
+            return {"ok": True, "watch": True, "cursor": self._epoch,
+                    "epoch": self._epoch}
+
+    def watch_take(self, worker: str) -> Dict:
+        """Drain one pending notification frame — the in-process stand-in
+        for the wire server's unsolicited push (a poll, because a hermetic
+        twin has no socket to write to). Empty queue answers notify=None."""
+        with self._lock:
+            q = self._watch_queues.get(worker or "")
+            if not q:
+                return {"ok": True, "notify": None, "cursor": self._epoch,
+                        "world": len(self._members)}
+            return q.popleft()
+
+    def watch_cancel(self, worker: str) -> Dict:
+        with self._lock:
+            cancelled = (worker or "") in self._watch_queues
+            self._watch_queues.pop(worker or "", None)
+            return {"ok": True, "cancelled": cancelled}
+
+    # -- shard routing (native parity: redirect_reply / op_shard_map) ----------
+
+    def redirect_for(self, key: str) -> Optional[Dict]:
+        """Redirect reply when this twin plays a sharded ROOT (endpoints
+        configured); None on a plain coordinator — so every keyspace shim
+        branch can guard with ``redirect_for(key) or <serve>``."""
+        with self._lock:
+            if not self._shard_endpoints:
+                return None
+            s = shard_of(str(key), len(self._shard_endpoints))
+            return {"ok": False, "error": "wrong shard",
+                    "redirect": self._shard_endpoints[s], "shard": s}
+
+    def shard_map(self) -> Dict:
+        with self._lock:
+            n = len(self._shard_endpoints) if self._shard_endpoints \
+                else self._num_shards
+            return {"ok": True, "root": bool(self._shard_endpoints),
+                    "nshards": n, "shards": list(self._shard_endpoints),
+                    "shard_index": self._shard_index}
+
+    # -- reply-shaped helpers for the wire shim --------------------------------
+
+    def kv_put_reply(self, key: str, value: str) -> Dict:
+        with self._lock:
+            if not key:
+                return {"ok": False, "error": "key required"}
+            self._kv[key] = value
+            return {"ok": True}
+
+    def kv_del_reply(self, key: str) -> Dict:
+        with self._lock:
+            self._kv.pop(key, None)
+            return {"ok": True}
+
+    def add_tasks_reply(self, tasks: List[str]) -> Dict:
+        with self._lock:
+            added = self.add_tasks(tasks)
+            return {"ok": True, "added": added, "queued": len(self._todo)}
 
     def status(self) -> Dict:
         with self._lock:
@@ -696,52 +795,75 @@ class InProcessClient:
             return self._c.leave(self.worker)
         if op == "members":
             return self._stamp({"ok": True, "members": self._c.members()})
+        # Keyspace ops guard with ``redirect_for(key) or <serve>`` — exactly
+        # the native handlers' shard-root redirect placement: None (plain
+        # coordinator) falls through to serving; a configured root answers
+        # the redirect before any validation, same as the C++ order.
         if op == "complete_task":
-            return self._stamp(self._c.complete_task(self.worker, fields["task"]))
+            return self._stamp(
+                self._c.redirect_for(fields["task"])
+                or self._c.complete_task(self.worker, fields["task"]))
         if op == "fail_task":
-            return self._stamp(self._c.fail_task(self.worker, fields["task"]))
+            return self._stamp(
+                self._c.redirect_for(fields["task"])
+                or self._c.fail_task(self.worker, fields["task"]))
         if op == "kv_put":
-            if not fields.get("key"):
-                return self._stamp({"ok": False, "error": "key required"})
-            self._c.kv_put(fields["key"], fields["value"])
-            return self._stamp({"ok": True})
+            return self._stamp(
+                self._c.redirect_for(fields.get("key", ""))
+                or self._c.kv_put_reply(fields.get("key", ""),
+                                        fields.get("value", "")))
         if op == "kv_incr":
-            return self._stamp(self._c.kv_incr_reply(
-                fields.get("key", ""), fields.get("delta", 1),
-                op_id=fields.get("op_id")))
+            return self._stamp(
+                self._c.redirect_for(fields.get("key", ""))
+                or self._c.kv_incr_reply(
+                    fields.get("key", ""), fields.get("delta", 1),
+                    op_id=fields.get("op_id")))
         if op == "shard_put":
-            return self._stamp(self._c.shard_put(
-                fields.get("owner", ""), int(fields.get("step", -1)),
-                int(fields.get("chunk", -1)), int(fields.get("chunks", 0)),
-                nbytes=int(fields.get("nbytes", 0)),
-                data=fields.get("data", ""),
-                put_id=fields.get("put_id"), group=fields.get("group")))
+            return self._stamp(
+                self._c.redirect_for(fields.get("owner", ""))
+                or self._c.shard_put(
+                    fields.get("owner", ""), int(fields.get("step", -1)),
+                    int(fields.get("chunk", -1)),
+                    int(fields.get("chunks", 0)),
+                    nbytes=int(fields.get("nbytes", 0)),
+                    data=fields.get("data", ""),
+                    put_id=fields.get("put_id"), group=fields.get("group")))
         if op == "shard_get":
-            return self._stamp(self._c.shard_get(
-                fields.get("owner", ""), int(fields.get("step", -1)),
-                int(fields.get("chunk", 0))))
+            return self._stamp(
+                self._c.redirect_for(fields.get("owner", ""))
+                or self._c.shard_get(
+                    fields.get("owner", ""), int(fields.get("step", -1)),
+                    int(fields.get("chunk", 0))))
         if op == "shard_meta":
-            return self._stamp(self._c.shard_meta(fields.get("owner", "")))
+            return self._stamp(
+                self._c.redirect_for(fields.get("owner", ""))
+                or self._c.shard_meta(fields.get("owner", "")))
         if op == "shard_drop":
-            return self._stamp(self._c.shard_drop(
-                fields.get("owner", ""), int(fields.get("step", -1))))
+            return self._stamp(
+                self._c.redirect_for(fields.get("owner", ""))
+                or self._c.shard_drop(
+                    fields.get("owner", ""), int(fields.get("step", -1))))
         if op == "kv_get":
             return self._stamp(
-                {"ok": True, "value": self._c.kv_get(fields["key"])})
+                self._c.redirect_for(fields.get("key", ""))
+                or {"ok": True, "value": self._c.kv_get(fields["key"])})
         if op == "kv_del":
-            self._c.kv_del(fields["key"])
-            return self._stamp({"ok": True})
+            return self._stamp(
+                self._c.redirect_for(fields.get("key", ""))
+                or self._c.kv_del_reply(fields.get("key", "")))
         if op == "acquire_task":
             return self._stamp(
-                self._c.acquire(self.worker, req_id=fields.get("req_id")))
+                self._c.redirect_for(self.worker)
+                or self._c.acquire(self.worker, req_id=fields.get("req_id")))
         if op == "add_tasks":
             tasks = fields.get("tasks")
             if not isinstance(tasks, list):
                 return self._stamp(
-                    {"ok": False, "error": "tasks array required"})
-            added = self._c.add_tasks(tasks)
-            queued = self._c.queued_count()
-            return self._stamp({"ok": True, "added": added, "queued": queued})
+                    self._c.redirect_for("")
+                    or {"ok": False, "error": "tasks array required"})
+            return self._stamp(
+                self._c.redirect_for(str(tasks[0]) if tasks else "")
+                or self._c.add_tasks_reply(tasks))
         if op == "barrier":
             return self._stamp(self._c.barrier(
                 self.worker, fields["name"], int(fields["count"]),
@@ -754,6 +876,18 @@ class InProcessClient:
             return self._c.bump_epoch()
         if op == "status":
             return self._c.status()
+        if op == "watch":
+            if fields.get("take"):
+                # In-process delivery: drain one pushed frame — the wire
+                # server writes these unsolicited to the subscriber's fd,
+                # a hermetic twin has no socket so the model polls instead.
+                return self._stamp(self._c.watch_take(self.worker))
+            return self._stamp(self._c.watch(
+                self.worker, int(fields.get("cursor", -1))))
+        if op == "watch_cancel":
+            return self._stamp(self._c.watch_cancel(self.worker))
+        if op == "shard_map":
+            return self._stamp(self._c.shard_map())
         if op == "batch":
             ops_arg = fields.get("ops")
             if not isinstance(ops_arg, list):
@@ -785,7 +919,7 @@ class InProcessClient:
             else:
                 op, fields = item
                 fields = dict(fields)
-            if op in ("batch", "barrier", "sync"):
+            if op in ("batch", "barrier", "sync", "watch"):
                 replies.append(
                     {"ok": False, "error": f"op not batchable: {op}"})
                 continue
@@ -795,6 +929,10 @@ class InProcessClient:
     def status(self):
         self._auth()
         return self._c.status()
+
+    def shard_map(self):
+        """CoordinatorClient.shard_map parity: the twin's partition layout."""
+        return self.call("shard_map")
 
     def ping(self):
         return True

@@ -14,7 +14,7 @@ import subprocess
 import tempfile
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from edl_tpu.coordinator.client import CoordinatorClient, CoordinatorError
 
@@ -87,6 +87,9 @@ class CoordinatorServer:
         state_file: Optional[str] = None,
         run_id: Optional[str] = None,
         auth_token: Optional[str] = None,
+        shards: Optional[List[str]] = None,
+        shard_index: int = -1,
+        num_shards: int = 0,
     ):
         self.port = port or free_port()
         self.task_lease_sec = task_lease_sec
@@ -107,6 +110,14 @@ class CoordinatorServer:
         #: every pod); "" explicitly disables auth.
         self.auth_token = auth_token if auth_token is not None \
             else os.environ.get("EDL_COORD_TOKEN", "")
+        #: sharded-root mode (--shards): host:port per shard server; the
+        #: process serves only membership/epoch/watch and redirects every
+        #: keyspace op by key hash.
+        self.shards = list(shards or [])
+        #: shard-server mode (--shard-index/--num-shards): serves its slice
+        #: of the keyspace; membership lives on the root.
+        self.shard_index = shard_index
+        self.num_shards = num_shards
         self._proc: Optional[subprocess.Popen] = None
         self._stderr_path: Optional[str] = None
         #: stderr of the last exited/stopped process (sanitizer reports live
@@ -130,6 +141,11 @@ class CoordinatorServer:
             argv += ["--state-file", self.state_file]
         if self.run_id:
             argv += ["--run-id", self.run_id]
+        if self.shards:
+            argv += ["--shards", ",".join(self.shards)]
+        if self.shard_index >= 0 and self.num_shards > 0:
+            argv += ["--shard-index", str(self.shard_index),
+                     "--num-shards", str(self.num_shards)]
         env = dict(os.environ)
         # Token travels by env, never argv (/proc/<pid>/cmdline is world-
         # readable); an empty token scrubs any inherited one so a
@@ -249,6 +265,85 @@ class CoordinatorServer:
     def client(self, worker: str = "") -> CoordinatorClient:
         return CoordinatorClient(port=self.port, worker=worker,
                                  token=self.auth_token)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class ShardedCoordinator:
+    """One partitioned control plane: a thin ROOT plus K shard servers.
+
+    The root owns membership, epochs, and watch subscriptions; every
+    keyspace op (KV, task leases, checkpoint shards) is redirected by FNV-1a
+    key hash to one of the shard servers, which each journal their own
+    slice. Clients learn the layout from the root's redirect/``shard_map``
+    replies (`CoordinatorClient` caches it and routes directly after the
+    first bounce), so the root's per-op work stops growing with keyspace
+    traffic — only membership scales on it.
+
+    Start order matters: shard servers come up first so the root never
+    advertises an endpoint that refuses connections.
+    """
+
+    def __init__(self, num_shards: int = 2,
+                 task_lease_sec: float = 16.0,
+                 heartbeat_ttl_sec: float = 10.0,
+                 auth_token: Optional[str] = None,
+                 state_dir: Optional[str] = None,
+                 run_id: Optional[str] = None):
+        def state(name: str) -> Optional[str]:
+            return os.path.join(state_dir, f"{name}.state") \
+                if state_dir else None
+
+        self.shards = [
+            CoordinatorServer(
+                task_lease_sec=task_lease_sec,
+                heartbeat_ttl_sec=heartbeat_ttl_sec,
+                auth_token=auth_token, run_id=run_id,
+                state_file=state(f"shard{i}"),
+                shard_index=i, num_shards=num_shards,
+            )
+            for i in range(num_shards)
+        ]
+        self.root = CoordinatorServer(
+            task_lease_sec=task_lease_sec,
+            heartbeat_ttl_sec=heartbeat_ttl_sec,
+            auth_token=auth_token, run_id=run_id,
+            state_file=state("root"),
+            shards=[s.address for s in self.shards],
+        )
+
+    @property
+    def port(self) -> int:
+        return self.root.port
+
+    @property
+    def address(self) -> str:
+        return self.root.address
+
+    def start(self, wait: float = 10.0) -> "ShardedCoordinator":
+        started = []
+        try:
+            for s in self.shards:
+                s.start(wait=wait)
+                started.append(s)
+            self.root.start(wait=wait)
+        except CoordinatorError:
+            for s in started:
+                s.stop()
+            raise
+        return self
+
+    def stop(self) -> None:
+        self.root.stop()
+        for s in self.shards:
+            s.stop()
+
+    def client(self, worker: str = "") -> CoordinatorClient:
+        return self.root.client(worker)
 
     def __enter__(self):
         return self.start()

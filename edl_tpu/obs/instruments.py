@@ -74,6 +74,21 @@ class WorkerInstruments:
             "edl_worker_epoch_observations_total",
             "membership epoch adoptions (register / rescale / outage rejoin)",
         )
+        self.epoch_notify_latency = r.histogram(
+            "edl_worker_epoch_notify_latency_seconds",
+            "delay between a pushed epoch notification arriving on the "
+            "watch stream and the worker loop consuming it (watch-based "
+            "discovery only; pull rounds never record here)",
+        )
+        self.epoch_notifies = r.counter(
+            "edl_worker_epoch_notifies_total",
+            "pushed epoch notifications consumed from the watch stream",
+        )
+        self.pulls_suppressed = r.counter(
+            "edl_worker_epoch_pulls_suppressed_total",
+            "dedicated pull rounds skipped because a healthy watch "
+            "subscription already covers epoch discovery",
+        )
         self.rescales = r.counter(
             "edl_worker_rescales_total",
             "completed elastic rescales (first post-rescale step done)",
@@ -119,6 +134,15 @@ class WorkerInstruments:
     def note_epoch(self, epoch: int) -> None:
         self.epoch.set(float(epoch))
         self.epoch_observations.inc()
+
+    def note_epoch_notify(self, latency_seconds: float) -> None:
+        """One pushed epoch notification consumed ``latency_seconds`` after
+        it arrived on the watch stream."""
+        self.epoch_notifies.inc()
+        self.epoch_notify_latency.observe(max(0.0, latency_seconds))
+
+    def note_pull_suppressed(self) -> None:
+        self.pulls_suppressed.inc()
 
 
 class ServeInstruments:

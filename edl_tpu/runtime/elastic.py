@@ -35,6 +35,7 @@ import jax
 from jax.sharding import Mesh
 
 from edl_tpu.coordinator.outbox import OutboxClient
+from edl_tpu.coordinator.watch import make_epoch_watch
 from edl_tpu.models.base import Model
 from edl_tpu.obs.instruments import WorkerInstruments
 from edl_tpu.obs.tracing import Tracer, get_tracer, rescale_trace_id
@@ -62,6 +63,15 @@ class ElasticConfig:
     #: otherwise phase-lock into synchronized heartbeat storms that turn
     #: the coordinator's load spiky (see doc/performance.md, control plane).
     heartbeat_jitter: float = 0.2
+    #: how epoch changes reach this worker: ``"watch"`` subscribes to the
+    #: coordinator's push stream (a rescale arrives in one RTT instead of a
+    #: heartbeat period) and treats a dead subscription as an error to
+    #: surface; ``"pull"`` keeps the pre-watch heartbeat-only discovery;
+    #: ``"auto"`` (default) subscribes when the transport supports it and
+    #: degrades silently to pull when it doesn't. Pull stays on as the
+    #: liveness fallback in every mode — the watch only *adds* latency
+    #: headroom and suppresses redundant dedicated pulls while healthy.
+    epoch_discovery: str = "auto"
     #: max wait for survivors at the rescale barrier; on timeout we proceed
     #: (the checkpoint is already durable, latecomers restore from it).
     rescale_barrier_timeout: float = 60.0
@@ -155,6 +165,10 @@ class ElasticConfig:
             raise ValueError(
                 f"ElasticConfig.policy must be 'adaptive' or 'static', "
                 f"got {self.policy!r}")
+        if self.epoch_discovery not in ("watch", "pull", "auto"):
+            raise ValueError(
+                f"ElasticConfig.epoch_discovery must be 'watch', 'pull' or "
+                f"'auto', got {self.epoch_discovery!r}")
         if self.peer_replicas < 0:
             raise ValueError(
                 f"ElasticConfig.peer_replicas must be >= 0 "
@@ -286,6 +300,17 @@ class ElasticWorker:
         raw = getattr(self.client, "client", self.client)
         if getattr(raw, "piggyback_heartbeat", None) == 0.0:
             raw.piggyback_heartbeat = config.heartbeat_interval
+        #: push-based epoch discovery: a watch subscription on the raw
+        #: transport (None when epoch_discovery='pull' or the transport
+        #: supports neither flavor). Pull stays the liveness fallback.
+        self._watch = make_epoch_watch(self.client, config.epoch_discovery)
+        if config.epoch_discovery == "watch" and self._watch is None:
+            raise ValueError(
+                "epoch_discovery='watch' but the transport exposes neither "
+                "a wire endpoint nor a call surface to subscribe on")
+        #: dedicated pull rounds skipped because a healthy watch already
+        #: covered epoch discovery (mirrors the metric family).
+        self.pulls_suppressed = 0
         #: True between observing the coordinator unreachable and the next
         #: successful control-plane call — gates benign epoch adoption.
         self._outage_open = False
@@ -355,6 +380,11 @@ class ElasticWorker:
         self._epoch = info["epoch"]
         self._world = max(1, info["world"])
         self._rank = int(info.get("rank", -1))
+        if self._watch is not None \
+                and int(self._epoch) > self._watch.last_epoch:
+            # Prime the resume cursor: epochs adopted via register/pull must
+            # not replay as notifications on the next (re)subscribe.
+            self._watch.last_epoch = int(self._epoch)
         self.obs.note_epoch(self._epoch)
         if self.ckpt_plane is not None:
             # New epoch = new rank numbering: publish the epoch's replica-
@@ -368,6 +398,11 @@ class ElasticWorker:
         if not info.get("ok"):
             info = self._register_blocking(takeover=True)
         self._adopt(info)
+        if self._watch is not None:
+            # Subscribe after the first adoption so the cursor is primed —
+            # the coordinator replays nothing we already know. Failure is
+            # not fatal: poll() retries with backoff, pull covers the gap.
+            self._watch.subscribe()
 
     def _register_blocking(self, takeover: bool = False) -> Dict:
         """Re-register, waiting out a coordinator outage — the PARKED state.
@@ -419,6 +454,25 @@ class ElasticWorker:
             self._drain_signal_t = time.time()
         return True
 
+    #: coalesce-window stretch while the watch is healthy: dedicated pulls
+    #: drop to 1/stretch cadence because discovery rides the push stream.
+    _WATCH_PULL_STRETCH = 3.0
+
+    def _consume_watch(self) -> bool:
+        """Drain pushed epoch notifications (non-blocking) and report
+        whether one names an epoch beyond ours. Arrival -> consumption
+        delay feeds `edl_worker_epoch_notify_latency_seconds`. A dead
+        subscription is not an error here: poll() re-subscribes with
+        bounded backoff and the pull cadence stays the liveness fallback.
+        """
+        now = time.monotonic()
+        moved = False
+        for epoch, arrived in self._watch.poll():
+            self.obs.note_epoch_notify(now - arrived)
+            if epoch > self._epoch:
+                moved = True
+        return moved
+
     def _epoch_changed(self, force: bool = False) -> bool:
         """Heartbeat (rate-limited) and report whether membership moved.
 
@@ -428,6 +482,12 @@ class ElasticWorker:
         the budget it reports True so run() checkpoints durably and parks.
         """
         now = time.monotonic()
+        # Push fast path: the watch stream is drained BEFORE the heartbeat
+        # rate limit — this is the whole latency win (a rescale notification
+        # interrupts the step loop in one RTT, not a heartbeat period).
+        # Draining is a non-blocking socket read, cheap enough per step.
+        if self._watch is not None and self._consume_watch():
+            return self._signal_drain()
         if not force and now - self._last_heartbeat < self._hb_interval:
             return False
         self._last_heartbeat = now
@@ -439,11 +499,23 @@ class ElasticWorker:
         # answers this beat without a dedicated RPC.
         lm = getattr(self.client, "last_membership", None)
         lm_at = getattr(self.client, "last_membership_at", 0.0)
-        if (not force and lm is not None
-                and now - lm_at < self.config.heartbeat_interval):
+        fresh_window = self.config.heartbeat_interval
+        if self._watch is not None and self._watch.connected:
+            # Watch healthy: epoch discovery rides the push stream, so the
+            # dedicated pull only backstops TTL refresh and liveness.
+            # Stretch the coalesce window (bounded — a fully idle transport
+            # still pulls at stretch x cadence, well inside the default TTL
+            # of ~10 intervals).
+            fresh_window *= self._WATCH_PULL_STRETCH
+        if not force and lm is not None and now - lm_at < fresh_window:
             reply = dict(lm)
             self.hb_coalesced += 1
             self.obs.note_coalesced_heartbeat()
+            if now - lm_at >= self.config.heartbeat_interval:
+                # Only the stretched window made this round coalesce: a
+                # pull the watch genuinely suppressed.
+                self.pulls_suppressed += 1
+                self.obs.note_pull_suppressed()
         else:
             reply = self.obs.timed_heartbeat(self.client)
         self.obs.note_outage_state(self.client)
@@ -733,22 +805,26 @@ class ElasticWorker:
         bound address — port 0 means ephemeral), with the coordinator's
         status counters bridged onto the same scrape.
         """
-        if self.config.metrics_port is None:
-            return self._run(max_rescales)
-        from edl_tpu.obs.bridge import CoordinatorStatusBridge
-        from edl_tpu.obs.http import MetricsServer
-
-        bridge = CoordinatorStatusBridge(self.client).register()
-        server = MetricsServer(port=self.config.metrics_port,
-                               tracer=self.tracer,
-                               health=self._health).start()
-        self.metrics_url = server.url  # edl: noqa[EDL001] set once at startup, before the serving thread handles requests
-        log.info("worker metrics at %s/metrics", server.url)
         try:
-            return self._run(max_rescales)
+            if self.config.metrics_port is None:
+                return self._run(max_rescales)
+            from edl_tpu.obs.bridge import CoordinatorStatusBridge
+            from edl_tpu.obs.http import MetricsServer
+
+            bridge = CoordinatorStatusBridge(self.client).register()
+            server = MetricsServer(port=self.config.metrics_port,
+                                   tracer=self.tracer,
+                                   health=self._health).start()
+            self.metrics_url = server.url  # edl: noqa[EDL001] set once at startup, before the serving thread handles requests
+            log.info("worker metrics at %s/metrics", server.url)
+            try:
+                return self._run(max_rescales)
+            finally:
+                bridge.unregister()
+                server.stop()
         finally:
-            bridge.unregister()
-            server.stop()
+            if self._watch is not None:
+                self._watch.close()
 
     def _health(self) -> Dict:
         return {

@@ -49,6 +49,7 @@ from jax.sharding import Mesh
 
 from edl_tpu.coordinator.client import CoordinatorAuthError, CoordinatorError
 from edl_tpu.coordinator.outbox import OutboxClient
+from edl_tpu.coordinator.watch import make_epoch_watch
 from edl_tpu.models.base import Model
 from edl_tpu.obs.instruments import WorkerInstruments
 from edl_tpu.parallel import MeshSpec, build_mesh
@@ -160,6 +161,19 @@ class MultiHostWorker:
         raw = getattr(self.client, "client", self.client)
         if getattr(raw, "piggyback_heartbeat", None) == 0.0:
             raw.piggyback_heartbeat = config.heartbeat_interval
+        #: push-based epoch discovery (same knob/semantics as ElasticWorker):
+        #: a notified epoch move is latched and consumed at the next round
+        #: boundary — a lockstep gang cannot react mid-collective.
+        self._watch = make_epoch_watch(self.client, config.epoch_discovery)
+        if config.epoch_discovery == "watch" and self._watch is None:
+            raise ValueError(
+                "epoch_discovery='watch' but the transport exposes neither "
+                "a wire endpoint nor a call surface to subscribe on")
+        self._epoch = -1
+        self._watch_moved = False
+        #: dedicated pull rounds skipped because a healthy watch already
+        #: covered epoch discovery (mirrors the metric family).
+        self.pulls_suppressed = 0
 
     # -- plumbing --------------------------------------------------------------
 
@@ -184,18 +198,43 @@ class MultiHostWorker:
         kv_get polls even that usually coalesces away (the transport records
         the membership observation; we just consume it).
         """
+        self._consume_watch()  # non-blocking drain; latches epoch moves
         now = time.monotonic()
         if now < self._next_hb:
             return
         self._next_hb = now + self._jittered(self.config.heartbeat_interval)
         lm = getattr(self.client, "last_membership", None)
         lm_at = getattr(self.client, "last_membership_at", 0.0)
-        if lm is not None and now - lm_at < self.config.heartbeat_interval:
+        fresh_window = self.config.heartbeat_interval
+        if self._watch is not None and self._watch.connected:
+            # Watch healthy: epoch discovery rides the push stream, so the
+            # dedicated pull only backstops TTL refresh and liveness
+            # (same stretch as ElasticWorker._WATCH_PULL_STRETCH).
+            fresh_window *= 3.0
+        if lm is not None and now - lm_at < fresh_window:
             self.hb_coalesced += 1
             self.obs.note_coalesced_heartbeat()
+            if now - lm_at >= self.config.heartbeat_interval:
+                self.pulls_suppressed += 1
+                self.obs.note_pull_suppressed()
             return
         self.obs.timed_heartbeat(self.client)  # fails soft under OutboxClient
         self.obs.note_outage_state(self.client)
+
+    def _consume_watch(self) -> bool:
+        """Drain pushed epoch notifications and latch whether one names an
+        epoch beyond the adopted one. The latch (not the transient poll
+        result) is what round boundaries consult — a notification that
+        arrives mid-round must still trigger the restart decision at the
+        NEXT boundary check."""
+        if self._watch is None:
+            return self._watch_moved
+        now = time.monotonic()
+        for ep, arrived in self._watch.poll():
+            self.obs.note_epoch_notify(now - arrived)
+            if ep > self._epoch:
+                self._watch_moved = True
+        return self._watch_moved
 
     def _build_mesh(self) -> Mesh:
         devices = jax.devices()  # global: every process's chips
@@ -259,6 +298,11 @@ class MultiHostWorker:
         backlog must be made durable first — either the queue drained down
         to our own held leases (flush before declaring exhausted) or the
         periodic interval elapsed."""
+        if self._consume_watch():
+            # A pushed notification already told us membership moved — skip
+            # the discovery RPC and head straight to the warm restart.
+            log.info("round %d: epoch moved (watch push); gang restart", rnd)
+            return {"stop": "rescale"}
         hb = self.client.heartbeat()
         while not hb.get("ok") and hb.get("unreachable"):
             # Coordinator outage: hold the gang on this round. Peers polling
@@ -399,6 +443,11 @@ class MultiHostWorker:
             if time.monotonic() >= deadline:
                 break
             self._maybe_heartbeat()
+            if self._consume_watch():
+                # Round boundary (no collective in flight): a pushed epoch
+                # move means this plan will never arrive from the old gang.
+                log.info("round %d: epoch moved (watch push); rescale", rnd)
+                return {"stop": "rescale"}
             time.sleep(0.05)
         log.warning("round %d plan never arrived; assuming rescale", rnd)
         return {"stop": "rescale"}
@@ -489,7 +538,11 @@ class MultiHostWorker:
         # SIGTERM -> drain at the next round boundary (no-op install off
         # the main thread — pytest drives workers from threads too).
         with main_thread_signal(signal.SIGTERM, _on_term):
-            return self._run(max_rounds)
+            try:
+                return self._run(max_rounds)
+            finally:
+                if self._watch is not None:
+                    self._watch.close()
 
     def _run(self, max_rounds: int) -> Dict[str, float]:
         rank = jax.process_index()
@@ -508,7 +561,14 @@ class MultiHostWorker:
             self._hb_sleep()
             info = self.client.register(takeover=True)
         epoch = int(info["epoch"])
+        self._epoch = epoch
         self.obs.note_epoch(epoch)
+        if self._watch is not None:
+            # Prime the resume cursor with the adopted epoch (it must not
+            # replay as a notification), then subscribe; failure is soft —
+            # poll() retries with backoff, the pull cadence covers the gap.
+            self._watch.last_epoch = max(self._watch.last_epoch, epoch)
+            self._watch.subscribe()
         if self.ckpt_plane is not None:
             # Every rank publishes the identical epoch-scoped placement map
             # (idempotent kv_put) and invalidates its previous epoch's key.

@@ -372,6 +372,19 @@ class Coordinator {
   // reply flushes so no ack outruns the disk.
   bool maybe_save_state();
 
+  // Root mode: the ordered shard endpoints ("host:port") the keyspace is
+  // hash-partitioned over. Non-empty turns every keyspace op into a
+  // redirect (the root keeps membership + routing only).
+  void set_shards(std::vector<std::string> endpoints) {
+    shard_endpoints_ = std::move(endpoints);
+  }
+  // Shard mode: this server's slot in the partition, reported via
+  // op_shard_map so clients/tools can confirm they dialed the right slice.
+  void set_shard_identity(long long index, long long count) {
+    shard_index_ = index;
+    num_shards_ = count;
+  }
+
  private:
   void load_state();
   bool save_snapshot();
@@ -429,6 +442,10 @@ class Coordinator {
   std::string op_shard_meta(const JsonObject& req);
   std::string op_shard_drop(const JsonObject& req);
   std::string op_bump_epoch();
+  std::string op_watch(const JsonObject& req, int fd);
+  std::string op_watch_cancel(const JsonObject& req, int fd);
+  std::string op_shard_map(const JsonObject& req);
+  std::string redirect_reply(const std::string& key);
   std::string op_status();
   std::string op_batch(const JsonObject& req, int fd);
   // Post-auth single-op dispatch; shared by handle() and batch sub-ops.
@@ -446,8 +463,24 @@ class Coordinator {
     return line;
   }
 
-  // Epoch is persisted so monotonicity survives restarts.
-  void bump_epoch() { epoch_++; record_epoch(); }
+  // Epoch is persisted so monotonicity survives restarts. Every bump also
+  // pushes a notification frame to the watch subscribers (the push path —
+  // a rescale reaches watchers in one RTT instead of a heartbeat period).
+  void bump_epoch() { epoch_++; record_epoch(); notify_watchers(); }
+  void notify_watchers();
+  void push_notify(int fd, long long e);
+  // FNV-1a 64-bit over the routing key. The constants are mirrored in
+  // edl_tpu/coordinator/sharding.py — both sides MUST agree, or the client
+  // routes a key to one shard while the root redirects it to another.
+  size_t key_shard(const std::string& key) const {
+    unsigned long long h = 1469598103934665603ull;
+    for (unsigned char c : key) {
+      h ^= (unsigned long long)c;
+      h *= 1099511628211ull;
+    }
+    return shard_endpoints_.empty() ? 0
+                                    : (size_t)(h % shard_endpoints_.size());
+  }
   // Release all parked sync waiters: ok=true when the epoch rendezvous
   // completed, ok=false (resync) when membership moved underneath them.
   void release_sync(bool ok);
@@ -555,6 +588,13 @@ class Coordinator {
   std::deque<std::string> shard_put_order_;
   static const size_t kShardPutSeenCap = 4096;
   std::vector<std::pair<int, std::string>> deferred_;
+  // Watch subscriptions: fds that get a push_notify frame on every bump.
+  // Connection-scoped (a dead fd is just erased in on_disconnect) — resume
+  // across reconnects is the CLIENT's job via the watch cursor.
+  std::unordered_set<int> watchers_;
+  std::vector<std::string> shard_endpoints_;  // root mode: addr per shard slot
+  long long shard_index_ = -1;                // shard mode: this server's slot
+  long long num_shards_ = 0;
   std::string state_file_;
   std::string run_id_;
   std::string auth_token_;  // empty = auth disabled (loopback-only dev runs)
@@ -798,6 +838,35 @@ void Coordinator::release_sync(bool ok) {
   sync_arrived_.clear();
 }
 
+// Push-path notification frame (op "watch"): pushed to every subscribed fd
+// the moment the membership epoch moves, and replayed once per missed epoch
+// when a subscription resumes with a cursor. "cursor" mirrors "epoch" so a
+// client can persist it verbatim as its resume point. Rides the deferred_
+// queue like barrier releases, so notifications observe the
+// durability-before-flush ordering.
+void Coordinator::push_notify(int fd, long long e) {
+  deferred_.push_back({fd, JsonWriter().field("ok", true)
+      .field("notify", "epoch").field("epoch", (double)e)
+      .field("cursor", (double)e)
+      .field("world", (double)members_.size()).done()});
+}
+
+// Push path: one frame per watcher the moment the epoch moves (the pull
+// path discovers the same bump a heartbeat period later).
+void Coordinator::notify_watchers() {
+  for (int fd : watchers_) push_notify(fd, epoch_);
+}
+
+// Root shard routing: the root owns membership only, so a keyspace op is
+// answered with the owning shard's endpoint + slot instead of being served.
+// Clients cache the shard map and re-resolve when they see this reply.
+std::string Coordinator::redirect_reply(const std::string& key) {
+  size_t idx = key_shard(key);
+  return JsonWriter().field("ok", false).field("error", "wrong shard")
+      .field("redirect", shard_endpoints_[idx])
+      .field("shard", (double)idx).done();
+}
+
 void Coordinator::drop_member(const std::string& name) {
   if (members_.erase(name)) {
     // Re-rank compactly: ranks are 0..N-1 in registration order of survivors
@@ -911,6 +980,17 @@ std::string Coordinator::op_members() {
 }
 
 std::string Coordinator::op_add_tasks(const JsonObject& req) {
+  if (!shard_endpoints_.empty()) {
+    // Roots don't own the task space. The client partitions tasks by hash
+    // before sending, so redirecting by the first task is exact for
+    // well-routed frames and still points a naive client at a real shard.
+    auto rit = req.find("tasks");
+    std::string first;
+    if (rit != req.end() && rit->second.kind == JsonValue::kStrArray &&
+        !rit->second.arr.empty())
+      first = rit->second.arr[0];
+    return redirect_reply(first);
+  }
   auto it = req.find("tasks");
   if (it == req.end() || it->second.kind != JsonValue::kStrArray)
     return JsonWriter().field("ok", false).field("error", "tasks array required").done();
@@ -931,6 +1011,10 @@ std::string Coordinator::op_add_tasks(const JsonObject& req) {
 std::string Coordinator::op_acquire_task(const JsonObject& req) {
   std::string worker = get_str(req, "worker");
   std::string req_id = get_str(req, "req_id");
+  // Root mode: leases live on the shards (tasks are hash-partitioned by
+  // name). Redirect by worker hash — a stable starting slot; the client
+  // rotates across all shards until one has work.
+  if (!shard_endpoints_.empty()) return redirect_reply(worker);
   // Dedup: a client that lost the reply retries the SAME logical acquire
   // (same req_id). Without this, the retry would pop a second task while
   // the first sits leased forever — renewed by every heartbeat, never
@@ -966,6 +1050,7 @@ std::string Coordinator::op_acquire_task(const JsonObject& req) {
 std::string Coordinator::op_complete_task(const JsonObject& req) {
   std::string task = get_str(req, "task");
   std::string worker = get_str(req, "worker");
+  if (!shard_endpoints_.empty()) return redirect_reply(task);
   // Idempotent: outbox replay after a reconnect (or a retry whose first
   // send did land) re-delivers completions. Already-done is success, not
   // an error — anything else forces callers to special-case replays.
@@ -1008,6 +1093,7 @@ std::string Coordinator::op_complete_task(const JsonObject& req) {
 std::string Coordinator::op_fail_task(const JsonObject& req) {
   std::string task = get_str(req, "task");
   std::string worker = get_str(req, "worker");
+  if (!shard_endpoints_.empty()) return redirect_reply(task);
   auto it = leased_.find(task);
   if (it == leased_.end())
     return JsonWriter().field("ok", false).field("error", "not leased").done();
@@ -1078,6 +1164,7 @@ std::string Coordinator::op_sync(const JsonObject& req, int fd) {
 
 std::string Coordinator::op_kv_put(const JsonObject& req) {
   std::string key = get_str(req, "key");
+  if (!shard_endpoints_.empty()) return redirect_reply(key);
   if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
   kv_[key] = get_str(req, "value");
   record_kv(key, kv_[key]);
@@ -1085,6 +1172,7 @@ std::string Coordinator::op_kv_put(const JsonObject& req) {
 }
 
 std::string Coordinator::op_kv_get(const JsonObject& req) {
+  if (!shard_endpoints_.empty()) return redirect_reply(get_str(req, "key"));
   auto it = kv_.find(get_str(req, "key"));
   JsonWriter w;
   w.field("ok", true);
@@ -1095,6 +1183,7 @@ std::string Coordinator::op_kv_get(const JsonObject& req) {
 
 std::string Coordinator::op_kv_del(const JsonObject& req) {
   std::string del_key = get_str(req, "key");
+  if (!shard_endpoints_.empty()) return redirect_reply(del_key);
   if (kv_.erase(del_key)) record_kv_del(del_key);
   return JsonWriter().field("ok", true).done();
 }
@@ -1104,6 +1193,7 @@ std::string Coordinator::op_kv_incr(const JsonObject& req) {
   // event loop, so concurrent clients (e.g. trainers bumping the job-wide
   // failure count) can never lose increments the way kv_get+kv_put can.
   std::string key = get_str(req, "key");
+  if (!shard_endpoints_.empty()) return redirect_reply(key);
   if (key.empty()) return JsonWriter().field("ok", false).field("error", "key required").done();
   long long delta = (long long)get_num(req, "delta", 1.0);
   // Exactly-once under retries AND restarts: an op_id marker is persisted
@@ -1145,6 +1235,7 @@ std::string Coordinator::op_shard_put(const JsonObject& req) {
   // the plane keeps only the latest replicated step per owner (a restore
   // wants the freshest covered state; history lives in blob storage).
   std::string owner = get_str(req, "owner");
+  if (!shard_endpoints_.empty()) return redirect_reply(owner);
   long long step = (long long)get_num(req, "step", -1);
   long long chunk = (long long)get_num(req, "chunk", -1);
   long long chunks = (long long)get_num(req, "chunks", 0);
@@ -1195,6 +1286,7 @@ std::string Coordinator::op_shard_get(const JsonObject& req) {
   // shard. step<0 means "latest"; a specific step must match exactly, so a
   // restorer never silently mixes chunks from two replication passes.
   std::string owner = get_str(req, "owner");
+  if (!shard_endpoints_.empty()) return redirect_reply(owner);
   long long step = (long long)get_num(req, "step", -1);
   long long chunk = (long long)get_num(req, "chunk", 0);
   auto it = shards_.find(owner);
@@ -1216,6 +1308,7 @@ std::string Coordinator::op_shard_meta(const JsonObject& req) {
   // chunk of the latest step is present — the restorer's go/no-go signal
   // before it starts pulling chunks (partial replication = blob fallback).
   std::string owner = get_str(req, "owner");
+  if (!shard_endpoints_.empty()) return redirect_reply(owner);
   auto it = shards_.find(owner);
   if (it == shards_.end() || it->second.step < 0)
     return JsonWriter().field("ok", true).field("found", false)
@@ -1235,6 +1328,7 @@ std::string Coordinator::op_shard_drop(const JsonObject& req) {
   // unconditionally; step>=0: only if the plane still holds exactly that
   // step — a drop racing a newer put must not destroy the newer blob).
   std::string owner = get_str(req, "owner");
+  if (!shard_endpoints_.empty()) return redirect_reply(owner);
   long long step = (long long)get_num(req, "step", -1);
   bool dropped = false;
   auto it = shards_.find(owner);
@@ -1252,6 +1346,44 @@ std::string Coordinator::op_bump_epoch() {
   bump_epoch();
   release_sync(false);
   return JsonWriter().field("ok", true).done();
+}
+
+std::string Coordinator::op_watch(const JsonObject& req, int fd) {
+  // Push subscription: this fd now receives a notification frame on every
+  // epoch bump. cursor >= 0 resumes a subscription after a reconnect:
+  // every epoch in (cursor, epoch_] is replayed exactly once, in order,
+  // BEFORE the ack — a watcher that missed bumps during an outage observes
+  // each one rather than only the endpoint. The ack's cursor equals the
+  // current epoch: "you are caught up as of here".
+  long long cursor = (long long)get_num(req, "cursor", -1);
+  watchers_.insert(fd);
+  if (cursor >= 0) {
+    for (long long e = cursor + 1; e <= epoch_; e++) push_notify(fd, e);
+  }
+  deferred_.push_back({fd, JsonWriter().field("ok", true)
+      .field("watch", true).field("cursor", (double)epoch_)
+      .field("epoch", (double)epoch_).done()});
+  return "";  // ack + replay ride deferred_
+}
+
+std::string Coordinator::op_watch_cancel(const JsonObject& req, int fd) {
+  (void)req;
+  bool cancelled = watchers_.erase(fd) > 0;
+  return JsonWriter().field("ok", true).field("cancelled", cancelled).done();
+}
+
+std::string Coordinator::op_shard_map(const JsonObject& req) {
+  (void)req;
+  // The routing artifact clients cache: root=true + the endpoint list on a
+  // root, root=false + this server's slot on a shard (or a plain single
+  // process, where nshards is 0 and routing is a no-op).
+  long long n = shard_endpoints_.empty() ? num_shards_
+                                         : (long long)shard_endpoints_.size();
+  return JsonWriter().field("ok", true)
+      .field("root", !shard_endpoints_.empty())
+      .field("nshards", (double)n)
+      .field("shards", shard_endpoints_)
+      .field("shard_index", (double)shard_index_).done();
 }
 
 std::string Coordinator::op_status() {
@@ -1308,10 +1440,12 @@ std::string Coordinator::op_batch(const JsonObject& req, int fd) {
         subreq["worker"] = std::move(wv);
       }
       std::string subop = get_str(subreq, "op");
-      if (subop == "batch" || subop == "barrier" || subop == "sync") {
+      if (subop == "batch" || subop == "barrier" || subop == "sync" ||
+          subop == "watch") {
         // barrier/sync park the fd and reply via deferred_ — a parked reply
-        // cannot be threaded into a frame's positional reply array. Nested
-        // frames are disallowed outright.
+        // cannot be threaded into a frame's positional reply array; a watch
+        // ack rides deferred_ the same way. Nested frames are disallowed
+        // outright.
         line = JsonWriter().field("ok", false)
             .field("error", "op not batchable: " + subop).done();
       } else {
@@ -1377,12 +1511,18 @@ std::string Coordinator::dispatch(const std::string& op, const JsonObject& req,
   if (op == "shard_meta") return op_shard_meta(req);
   if (op == "shard_drop") return op_shard_drop(req);
   if (op == "bump_epoch") return op_bump_epoch();
+  if (op == "watch") return op_watch(req, fd);
+  if (op == "watch_cancel") return op_watch_cancel(req, fd);
+  if (op == "shard_map") return op_shard_map(req);
   if (op == "status") return op_status();
   if (op == "ping") return JsonWriter().field("ok", true).field("pong", true).done();
   return JsonWriter().field("ok", false).field("error", "unknown op: " + op).done();
 }
 
 void Coordinator::on_disconnect(int fd) {
+  // A watch subscription is connection-scoped: the client resumes on its
+  // next connection with the cursor it last observed.
+  watchers_.erase(fd);
   // Withdraw the worker's pending barrier arrival along with its waiter
   // entry: a crashed/disconnected worker must not count toward the barrier
   // (matches the Python twin's timeout withdrawal) — otherwise survivors
@@ -1556,6 +1696,9 @@ int main(int argc, char** argv) {
   std::string run_id;
   double task_lease = 16.0;   // ref: -task-timout-dur 16s (docker/paddle_k8s:30)
   double hb_ttl = 10.0;
+  std::string shards_arg;     // root mode: comma-separated shard endpoints
+  long long shard_index = -1; // shard mode: this server's slot
+  long long num_shards = 0;
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() -> const char* { return i + 1 < argc ? argv[++i] : ""; };
@@ -1565,9 +1708,13 @@ int main(int argc, char** argv) {
     else if (a == "--run-id") run_id = next();
     else if (a == "--task-lease-sec") task_lease = atof(next());
     else if (a == "--heartbeat-ttl-sec") hb_ttl = atof(next());
+    else if (a == "--shards") shards_arg = next();
+    else if (a == "--shard-index") shard_index = atoll(next());
+    else if (a == "--num-shards") num_shards = atoll(next());
     else if (a == "--help") {
       printf("edl-coordinator --port N [--host A] [--state-file P] "
-             "[--run-id ID] [--task-lease-sec S] [--heartbeat-ttl-sec S]\n");
+             "[--run-id ID] [--task-lease-sec S] [--heartbeat-ttl-sec S] "
+             "[--shards H:P,H:P,...] [--shard-index I --num-shards N]\n");
       return 0;
     }
   }
@@ -1594,6 +1741,20 @@ int main(int argc, char** argv) {
   fflush(stderr);
 
   Coordinator coord(task_lease, hb_ttl, state_file, run_id, auth_token);
+  if (!shards_arg.empty()) {
+    std::vector<std::string> eps;
+    size_t start = 0;
+    while (start <= shards_arg.size()) {
+      size_t comma = shards_arg.find(',', start);
+      if (comma == std::string::npos) comma = shards_arg.size();
+      if (comma > start) eps.push_back(shards_arg.substr(start, comma - start));
+      start = comma + 1;
+    }
+    fprintf(stderr, "edl-coordinator: root mode over %zu shard(s)\n",
+            eps.size());
+    coord.set_shards(std::move(eps));
+  }
+  if (shard_index >= 0) coord.set_shard_identity(shard_index, num_shards);
   if (!coord.state_writable()) {
     fprintf(stderr, "edl-coordinator: --state-file %s not writable\n",
             state_file.c_str());

@@ -279,6 +279,41 @@ def test_bench_cell_contract(monkeypatch, tmp_path):
     assert after["batch_subops"] == 2 * after["batch_frames"]
 
 
+@needs_native
+def test_bench_topology_cell_contract(tmp_path):
+    """Tiny single-vs-sharded cells through the multiplexed logical-worker
+    path: more logical workers than connections, counters move on every
+    server, and the cells report comparable fields."""
+    import bench_coord
+
+    single = bench_coord.run_topology_cell("single", 64, 0.4, 0.1, 8,
+                                           str(tmp_path), kv_bytes=64)
+    sharded = bench_coord.run_topology_cell("sharded", 64, 0.4, 0.1, 8,
+                                            str(tmp_path), kv_bytes=64)
+    for cell in (single, sharded):
+        assert cell["beats"] > 0
+        assert cell["ops_per_sec"] > 0
+        assert cell["p99_ms"] is not None and cell["p99_ms"] > 0
+        assert cell["connections"] <= 8  # 64 logical workers multiplexed
+    assert single["servers"] == 1
+    assert sharded["servers"] == 3  # root + 2 shards
+
+
+@needs_native
+def test_bench_propagation_pull_vs_push(tmp_path):
+    """One epoch bump against a paced-pull fleet and a watch fleet: every
+    worker discovers it, and push lands far inside the polling period."""
+    import bench_coord
+
+    rep = bench_coord.run_propagation(16, 0.4, str(tmp_path))
+    assert rep["pull"]["discovered"] == 16
+    assert rep["push"]["discovered"] == 16
+    # pull pays the polling cadence; push is an RTT. Generous bound so a
+    # loaded CI host can't flake it.
+    assert rep["push"]["mean_ms"] < rep["pull"]["mean_ms"]
+    assert rep["push_p99_over_period"] < 0.5
+
+
 @pytest.mark.slow
 @needs_native
 def test_bench_coord_smoke_1k(monkeypatch, tmp_path):
@@ -287,6 +322,7 @@ def test_bench_coord_smoke_1k(monkeypatch, tmp_path):
     import bench_coord
 
     out = tmp_path / "BENCH_COORD.json"
+    monkeypatch.setenv("EDL_COORD_SECTIONS", '["arms"]')
     monkeypatch.setenv("EDL_COORD_NS", "[1000]")
     monkeypatch.setenv("EDL_COORD_MODES", '["duty"]')
     monkeypatch.setenv("EDL_COORD_SECS", "1.0")

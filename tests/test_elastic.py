@@ -429,3 +429,70 @@ def test_zero1_checkpoint_restores_across_mesh_sizes(tmp_path):
     )
     assert np.isfinite(float(loss))
     ckpt.close()
+
+
+# -- push-based epoch discovery (watch satellite) -------------------------------
+
+
+def _watch_worker(tmp_path, coord, **cfg_kw):
+    model = fit_a_line.MODEL
+    cfg = ElasticConfig(
+        checkpoint_dir=str(tmp_path / "ck"),
+        heartbeat_interval=30.0,  # pull alone would take 30 s to notice
+        **cfg_kw,
+    )
+    source = SyntheticShardSource(model, batch_size=8, batches_per_shard=2)
+    return ElasticWorker(model, coord.client("trainer-0"), source, cfg)
+
+
+def test_epoch_discovery_knob_is_validated():
+    with pytest.raises(ValueError, match="epoch_discovery"):
+        ElasticConfig(checkpoint_dir="x", epoch_discovery="telepathy")
+
+
+def test_epoch_discovery_pull_disables_the_watch(tmp_path):
+    coord = InProcessCoordinator(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    worker = _watch_worker(tmp_path, coord, epoch_discovery="pull")
+    assert worker._watch is None
+
+
+def test_watch_interrupts_inside_the_heartbeat_interval(tmp_path):
+    """The push win: with a 30 s heartbeat interval, a bump_epoch must still
+    flip _epoch_changed() on the very next check — discovery rides the watch
+    stream, not the pull cadence."""
+    coord = InProcessCoordinator(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    worker = _watch_worker(tmp_path, coord)
+    worker._sync_membership()
+    assert worker._watch is not None and worker._watch.connected
+    # own registration epoch must not replay as a notification
+    assert worker._epoch_changed() is False
+    coord.bump_epoch()
+    t0 = time.monotonic()
+    assert worker._epoch_changed() is True
+    assert time.monotonic() - t0 < 1.0
+    assert worker._watch.notifies_total >= 1
+
+
+def test_watch_dead_subscription_degrades_to_pull(tmp_path):
+    """A broken watch is silent degradation, not a stall: _epoch_changed
+    falls through to the pull path and still reports the move."""
+    coord = InProcessCoordinator(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    worker = _watch_worker(tmp_path, coord)
+    worker._sync_membership()
+
+    class DeadWatch:
+        connected = False
+        last_epoch = -1
+
+        def poll(self, timeout=0.0):
+            return []
+
+        def subscribe(self, timeout=5.0):
+            return False
+
+        def close(self):
+            pass
+
+    worker._watch = DeadWatch()
+    coord.bump_epoch()
+    assert worker._epoch_changed(force=True) is True

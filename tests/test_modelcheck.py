@@ -66,11 +66,12 @@ def test_default_exhaustive_is_green_and_fully_replayed():
     elapsed = time.monotonic() - t0
     assert result.violations == []
     # C(13, 6) interleavings of the default scripts + C(8, 4) of the
-    # checkpoint-plane schedule (run_default merges both)
-    assert result.traces == 1716 + 70
+    # checkpoint-plane schedule + C(11, 3) watch/notify + C(10, 4)
+    # redirect-during-watch (run_default merges all four)
+    assert result.traces == 1716 + 70 + 165 + 210
     assert result.replays == result.traces
     assert result.ok()
-    assert elapsed < 60.0
+    assert elapsed < 90.0
 
 
 def test_default_scripts_meet_the_bounded_config_contract():
@@ -87,7 +88,7 @@ def test_state_effects_cover_the_full_op_set():
     effects, ops, err = load_state_effects(REPO_ROOT)
     assert err is None
     assert set(effects) == ops
-    assert len(ops) >= 18
+    assert len(ops) >= 21
 
 
 # -- teeth: the mutated twin ----------------------------------------------------
@@ -116,8 +117,9 @@ def test_mutant_violation_messages_name_the_replayed_request():
 def test_fuzz_on_green_twin_stays_green():
     result = run_default(fuzz_samples=40, fuzz_seed=7)
     assert result.violations == []
-    # 40 samples per schedule (default + ckpt-plane), identical ones dedup
-    assert 0 < result.traces <= 80
+    # 40 samples per schedule (default, ckpt-plane, watch, redirect),
+    # identical ones dedup
+    assert 0 < result.traces <= 160
     assert result.replays == result.traces
 
 
@@ -230,7 +232,7 @@ def test_cli_exhaustive_exits_zero(capsys):
     rc = modelcheck_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "1786 trace(s)" in out and "0 violation(s)" in out
+    assert "2161 trace(s)" in out and "0 violation(s)" in out
 
 
 def test_cli_json_fuzz(capsys):
