@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck tsan-smoke bench-coord-smoke verify bench-pipeline bench-coord bench-collective bench-serve
+.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -61,12 +61,33 @@ ckpt-plane-smoke:
 
 ## Protocol behavior gate: bounded explicit-state exploration of every
 ## interleaving of the default faulty 2-worker schedule (crash+restart,
-## duplicate delivery, batch frame), each trace replayed against
-## InProcessCoordinator as the executable oracle. Exit 1 on any invariant
-## violation (epoch monotonicity, exactly-once, lease exclusivity,
-## progress) or model/oracle divergence. See doc/analysis.md (EDL009).
+## duplicate delivery, batch frame) PLUS the EDL010 durability schedules
+## (crash points between persistence effects: clean / pre-ack / torn tail
+## / during compaction, recovery replay as a schedule step), each trace
+## replayed against the in-process oracle (the durability rows use its
+## file-backed persistence twin). Exit 1 on any invariant violation
+## (epoch monotonicity, exactly-once across crash, acked-implies-durable,
+## lease exclusivity, progress) or model/oracle divergence. See
+## doc/analysis.md (EDL009 + EDL010).
 modelcheck:
-	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.analysis.modelcheck
+	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.analysis.modelcheck --timings
+
+## Crash-injected native oracle lane: the same durability schedules, but
+## every trace replays against the REAL edl-coordinator binary — the
+## modeled crash point is realized by env-gated _exit(2) hooks in
+## coordinator.cc (EDL_COORD_CRASH_AFTER_APPENDS / _CRASH_TORN /
+## _CRASH_IN_SNAPSHOT), with a genuine kill + recovery-from-disk per
+## trace. Proves the C++ journal replay (torn-tail truncation, dedup
+## rebuild, snapshot+suffix equivalence) matches the model bit-for-bit.
+## TSan-aware (EDL_COORD_SANITIZER=tsan instruments the binary); skips
+## cleanly when no C++ toolchain is installed.
+modelcheck-native:
+	@if ! command -v $${CXX:-g++} >/dev/null 2>&1; then \
+		echo "modelcheck-native: no C++ toolchain ($${CXX:-g++} not found) — skipping"; \
+	else \
+		JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.analysis.modelcheck \
+			--native --timings; \
+	fi
 
 ## Native race gate: rebuild the coordinator under ThreadSanitizer and rerun
 ## the sanitizer-marked lane (chaos/outage/batch/hammer tests) against it.
@@ -91,12 +112,12 @@ tsan-smoke:
 bench-coord-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_coord.py --smoke
 
-## Everything a PR must pass: static analysis (EDL001-EDL009 vs baseline +
-## protocol_schema.json ratchet), tier-1 tests, protocol model check,
-## serving smoke, TSan lane, bench-harness smoke. Tier-2 (slow, run before
-## cutting a release): `make chaos` / `make chaos-composed` — soaks +
-## composed cross-axis run.
-verify: analyze test modelcheck serve-smoke ckpt-plane-smoke tsan-smoke bench-coord-smoke
+## Everything a PR must pass: static analysis (EDL001-EDL010 vs baseline +
+## protocol_schema.json ratchet), tier-1 tests, protocol + durability model
+## checks (in-process AND crash-armed native oracle), serving smoke, TSan
+## lane, bench-harness smoke. Tier-2 (slow, run before cutting a release):
+## `make chaos` / `make chaos-composed` — soaks + composed cross-axis run.
+verify: analyze test modelcheck modelcheck-native serve-smoke ckpt-plane-smoke tsan-smoke bench-coord-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -13,6 +13,7 @@ from edl_tpu.analysis.checkers.elastic_determinism import (
     ElasticDeterminismChecker,
 )
 from edl_tpu.analysis.checkers.protocol_model import ProtocolModelChecker
+from edl_tpu.analysis.checkers.durability import DurabilityModelChecker
 
 ALL_CHECKERS = (
     LockDisciplineChecker,
@@ -24,6 +25,7 @@ ALL_CHECKERS = (
     WireProtocolChecker,
     ElasticDeterminismChecker,
     ProtocolModelChecker,
+    DurabilityModelChecker,
 )
 
 RULES = {c.rule: c for c in ALL_CHECKERS}

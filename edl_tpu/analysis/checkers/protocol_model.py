@@ -128,18 +128,22 @@ class ProtocolModelChecker:
             # The acceptance schedules (faulty base, checkpoint plane,
             # watch/notify, redirect-during-watch) — each explored
             # separately so every schedule stays inside the interleaving
-            # budget; findings merge.
-            for scripts, factory, endpoints in default_schedules():
+            # budget; findings merge. Durability rows belong to EDL010 and
+            # are filtered out here.
+            for sched in default_schedules():
+                if sched.durable:
+                    continue
                 result = explore(
-                    scripts,
+                    sched.scripts,
                     effects,
-                    coordinator_factory=factory,
+                    coordinator_factory=sched.factory,
                     max_traces=int(
                         ctx.config.get("edl009_max_traces", 20000)),
                     max_violations=MAX_VIOLATION_FINDINGS * 4,
                     fuzz_samples=fuzz,
                     fuzz_seed=int(ctx.config.get("edl009_fuzz_seed", 0)),
-                    shard_endpoints=endpoints,
+                    shard_endpoints=sched.shard_endpoints,
+                    name=sched.name,
                 )
                 violations.extend(result.violations)
         except ModelCheckError as e:

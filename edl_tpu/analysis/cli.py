@@ -30,7 +30,7 @@ def _build_parser() -> argparse.ArgumentParser:
             "codebase (lock-discipline, trace-hygiene, sharding-"
             "consistency, blocking-in-lock, exception-hygiene, "
             "thread-races, wire-protocol, elastic-determinism, "
-            "protocol-model)."
+            "protocol-model, durability-model)."
         ),
     )
     parser.add_argument(

@@ -32,22 +32,53 @@ trace set — and therefore violation set — is provably a subset of the
 exhaustive run at equal depth: both draw schedules from the same runnable
 sets, the walk just samples one branch per node.
 
+**Durability lane (EDL010).** Schedules flagged ``durable`` additionally
+split the model into volatile vs durable halves: every op's ``durability``
+tag in ``state_effects`` declares which journal records it emits
+(``journal:kv``, ``journal:meta,lease``, ``volatile``, ``none``), handlers
+emit those records into a per-turn frame, and each frame group-commits
+(one fsync per event-loop turn, a trailing commit-marker record closing
+the frame — mirroring the native journal byte-for-byte in structure). A
+``crash`` pseudo-op (modes ``clean`` / ``pre_ack`` / ``torn`` /
+``during_compaction``) is a first-class schedule step: the DFS interleaves
+it like any other op, so its position enumerates every crash point; its
+semantics discard volatile state, replay the committed journal exactly the
+way ``load_state`` does (epoch+1, leases restored under holders, req_id
+dedup cache rebuilt, torn tail frames dropped whole), and its oracle
+realization kills and restarts a REAL coordinator — the file-backed
+``InProcessCoordinator`` persistence twin in the default lane, the native
+binary with env-gated crash injection (``EDL_COORD_CRASH_AFTER_APPENDS``)
+in ``edl_tpu.analysis.native_oracle``. Invariants added on top of the
+four above: acked-implies-durable, exactly-once across crash,
+snapshot⊕journal-suffix equivalence at every compaction, epoch
+monotonicity across restart, and ladder honesty for the deliberately
+unjournaled shard store (loss may cost a recovery rung, never contradict
+a durable ack). A sleep-set partial-order reduction over commuting ops
+(disjoint static footprints; any epoch-writing op conflicts with
+everything) keeps crash-point exploration inside EDL009's budget.
+
 ``python -m edl_tpu.analysis.modelcheck`` runs the default bounded
-configuration — four merged schedules: the 2-worker faulty base (13 ops
-including ``batch``, one crash+restart, two duplicate deliveries), the
-checkpoint-plane ops, a watch/notify schedule (resume-cursor replay,
-duplicate notification delivery via a stale re-subscribe), and a
-redirect-during-watch schedule against a sharded root — and exits 1 on any
-violation: the ``make modelcheck`` gate.
+configuration — the 2-worker faulty base (13 ops including ``batch``, one
+crash+restart, two duplicate deliveries), the checkpoint-plane ops, a
+watch/notify schedule, a redirect-during-watch schedule against a sharded
+root, and the durability schedules (post-fsync survival, pre-fsync loss,
+torn tail, crash-during-compaction, shard-store-across-crash) — and exits
+1 on any violation: the ``make modelcheck`` gate. ``--schedules`` filters
+by name, ``--dump-trace`` writes the first violating interleaving as a
+JSON spec, ``--replay-trace`` re-executes such a spec in isolation.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
+import tempfile
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+import time
+import weakref
+from dataclasses import dataclass, field, replace as _dc_replace
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from edl_tpu.coordinator.sharding import shard_of
 
@@ -62,10 +93,68 @@ _NON_BATCHABLE = ("batch", "barrier", "sync", "watch")
 #: reported once at the acquire, not echoed by every downstream op).
 LAST_TASK = "__edl_modelcheck_last_task__"
 
+#: the crash pseudo-op (not a wire op): kills the coordinator at this point
+#: in the interleaving and replays recovery. Scheduled like any other
+#: ScriptOp, so DFS position = crash point.
+CRASH_OP = "crash"
+
+#: crash modes. ``clean``: nothing in flight, recover to the committed
+#: journal. ``pre_ack``: the inflight op's frame is appended AND fsynced but
+#: the reply never flushes — its effects must survive (post-fsync survival).
+#: ``torn``: the inflight frame is appended but torn mid-write (no commit
+#: marker reaches disk) — the WHOLE frame must be absent after recovery
+#: (pre-fsync loss; all-or-nothing is the frame contract). #:
+#: ``during_compaction``: the inflight op triggers a snapshot that dies
+#: after the tmp write, before the rename — the journal is untouched and
+#: the inflight effects are lost, unacked.
+CRASH_MODES = ("clean", "pre_ack", "torn", "during_compaction")
+
+#: journal record kinds, mirroring the native journal line vocabulary.
+_JOURNAL_KINDS = ("meta", "todo", "done", "lease", "kv", "kvdel")
+
 
 class ModelCheckError(Exception):
     """The schema's state_effects block cannot drive the model (missing op,
     unknown effect tag): a behavioral-spec error, not a trace violation."""
+
+
+class _SnapshotDivergence(Exception):
+    """The model's own snapshot⊕journal-suffix self-check failed: replaying
+    the compacted journal did not reconstruct the live durable state. The
+    explorer converts this into a ``snapshot-divergence`` violation on the
+    trace that triggered the compaction."""
+
+
+#: sentinel distinguishing "no durability tag at all" from an empty kind set.
+_MISSING_TAG = object()
+
+
+def _durability_kinds(effects: Dict[str, Dict[str, Any]], op: str):
+    """Parse an op's ``durability`` tag into its declared journal-record
+    kind set. ``none``/``volatile`` -> empty set (the op must emit no
+    journal records), ``journal:<k1,k2>`` -> {k1, k2}, ``composite`` ->
+    None (batch: checked against the union of its sub-ops), missing ->
+    ``_MISSING_TAG``."""
+    tag = effects.get(op, {}).get("durability")
+    if tag is None:
+        return _MISSING_TAG
+    if tag in ("none", "volatile"):
+        return set()
+    if tag == "composite":
+        return None
+    if isinstance(tag, str) and tag.startswith("journal:"):
+        kinds = {k.strip() for k in tag[len("journal:"):].split(",") if k.strip()}
+        bad = kinds - set(_JOURNAL_KINDS)
+        if bad:
+            raise ModelCheckError(
+                f"state_effects[{op!r}] durability tag names unknown "
+                f"journal kind(s) {sorted(bad)} — known: {_JOURNAL_KINDS}"
+            )
+        return kinds
+    raise ModelCheckError(
+        f"state_effects[{op!r}] durability tag {tag!r} is malformed — "
+        "expected journal:<kinds>, volatile, none, or composite"
+    )
 
 
 @dataclass(frozen=True)
@@ -113,9 +202,12 @@ class ScriptOp:
 @dataclass
 class Violation:
     kind: str  # epoch-monotonicity | exactly-once | lease-exclusivity |
-    #            progress | oracle-divergence | conservation
+    #            progress | oracle-divergence | conservation |
+    #            acked-durability | snapshot-divergence
     message: str
     trace: str  # stable rendering of the schedule that produced it
+    schedule: str = ""  # named schedule that produced it ("" for ad-hoc)
+    order: Tuple[str, ...] = ()  # worker step order, for --dump-trace
 
     def key(self) -> Tuple[str, str]:
         return (self.kind, self.trace)
@@ -126,6 +218,8 @@ class ModelCheckResult:
     traces: int = 0
     replays: int = 0
     violations: List[Violation] = field(default_factory=list)
+    #: per-schedule (name, traces, seconds) rows — the --timings split.
+    timings: List[Tuple[str, int, float]] = field(default_factory=list)
 
     def ok(self) -> bool:
         return not self.violations
@@ -146,11 +240,13 @@ class ProtocolModel:
 
     _KNOWN_TAGS = {
         "epoch", "lease", "dedup", "kv", "queue", "membership", "parks",
-        "composite", "shard", "watch", "routing",
+        "composite", "shard", "watch", "routing", "durability",
     }
 
     def __init__(self, effects: Dict[str, Dict[str, Any]],
-                 shard_endpoints: Optional[Sequence[str]] = None):
+                 shard_endpoints: Optional[Sequence[str]] = None,
+                 durable: bool = False,
+                 compact_every: Optional[int] = None):
         for op, tags in effects.items():
             unknown = set(tags) - self._KNOWN_TAGS
             if unknown:
@@ -159,6 +255,31 @@ class ProtocolModel:
                     f"{sorted(unknown)}"
                 )
         self.effects = effects
+        # Durable half (EDL010): the journal as committed frames. Volatile
+        # state is everything below; the journal is what a crash preserves.
+        self.durable = durable
+        self.compact_every = compact_every
+        if durable:
+            for op in effects:
+                if _durability_kinds(effects, op) is _MISSING_TAG:
+                    raise ModelCheckError(
+                        f"state_effects[{op!r}] has no durability tag — "
+                        "every op needs one before the durability model "
+                        "can run (journal:<kinds>, volatile, none, or "
+                        "composite)"
+                    )
+        #: committed frames, each a tuple of journal records. The first
+        #: frame at boot is the meta record load_state queues on a missing
+        #: state file. A snapshot replaces the whole list with one frame.
+        self.journal: List[Tuple[Tuple[Any, ...], ...]] = []
+        self.frames = 0  # append batches (group commits), incl. boot frame
+        self.records_since = 0  # journal lines since last snapshot
+        self.snapshots = 0
+        self._pending: List[Tuple[Any, ...]] = []  # current turn's records
+        self._apply_depth = 0
+        self.last_crash_info: Optional[Dict[str, Any]] = None
+        if durable:
+            self._append_frame((("meta", 0),))  # boot: record_epoch()
         # Sharded-ROOT mode (native --shards): with endpoints configured,
         # every keyspace op answers a redirect instead of being served.
         self.shard_endpoints: List[str] = list(shard_endpoints or [])
@@ -182,6 +303,15 @@ class ProtocolModel:
     def copy(self) -> "ProtocolModel":
         m = ProtocolModel.__new__(ProtocolModel)
         m.effects = self.effects
+        m.durable = self.durable
+        m.compact_every = self.compact_every
+        m.journal = list(self.journal)  # frames are immutable tuples
+        m.frames = self.frames
+        m.records_since = self.records_since
+        m.snapshots = self.snapshots
+        m._pending = list(self._pending)
+        m._apply_depth = self._apply_depth
+        m.last_crash_info = self.last_crash_info
         m.shard_endpoints = list(self.shard_endpoints)
         m.epoch = self.epoch
         m.members = dict(self.members)
@@ -215,6 +345,10 @@ class ProtocolModel:
     # this event unblocked.
 
     def apply(self, worker: str, op: str, fields: Dict[str, Any]):
+        if op == CRASH_OP:
+            if self._apply_depth:
+                raise ModelCheckError("crash cannot nest inside batch")
+            return self._op_crash(worker, fields)
         if op not in self.effects:
             raise ModelCheckError(
                 f"op {op!r} has no state_effects entry in the schema"
@@ -222,7 +356,299 @@ class ProtocolModel:
         handler = getattr(self, f"_op_{op}", None)
         if handler is None:
             raise ModelCheckError(f"model has no handler for op {op!r}")
-        return handler(worker, fields)
+        self._apply_depth += 1
+        try:
+            out = handler(worker, fields)
+        finally:
+            self._apply_depth -= 1
+        if self.durable and self._apply_depth == 0:
+            self._check_durability_tag(op, fields)
+            self._commit_frame()
+        return out
+
+    # -- durable plane: journal frames, commit, snapshot, recovery replay ------
+
+    def _rec(self, *record: Any) -> None:
+        """Emit one journal record into the current turn's frame. Handlers
+        call this exactly where the native server calls its ``record_*``
+        helpers; a no-op outside durable mode."""
+        if self.durable:
+            self._pending.append(tuple(record))
+
+    def _check_durability_tag(self, op: str, fields: Dict[str, Any]) -> None:
+        """Dynamic half of the durability ratchet: the records an op's
+        handler actually emitted must be covered by its declared tag (batch
+        checks against the union of its sub-ops' tags)."""
+        emitted = {r[0] for r in self._pending}
+        if not emitted:
+            return
+        allowed = _durability_kinds(self.effects, op)
+        if allowed is None:  # composite: union over sub-ops
+            allowed = set()
+            for sub in fields.get("ops", []) or []:
+                sub_kinds = _durability_kinds(self.effects,
+                                              sub.get("op", ""))
+                if isinstance(sub_kinds, set):
+                    allowed |= sub_kinds
+        if allowed is _MISSING_TAG or emitted - allowed:
+            raise ModelCheckError(
+                f"durability tag drift: op {op!r} emitted journal "
+                f"record kind(s) {sorted(emitted)} but its durability tag "
+                f"declares {sorted(allowed) if isinstance(allowed, set) else 'nothing'}"
+            )
+
+    def _append_frame(self, frame: Tuple[Tuple[Any, ...], ...]) -> None:
+        """Group-commit one frame: append + trailing commit marker + fsync
+        (the marker is implicit here — `len(frame) + 1` records — and a
+        literal ``{"k":"c"}`` line on disk in both real journals)."""
+        self.journal.append(frame)
+        self.frames += 1
+        self.records_since += len(frame) + 1
+
+    def _commit_frame(self) -> None:
+        """End of an event-loop turn: group-commit the pending records —
+        or, past the compaction threshold, fold everything into a snapshot
+        (the native ``maybe_save_state`` shape: the threshold is checked
+        BEFORE appending, and the snapshot covers the pending effects
+        because in-memory state already has them)."""
+        if not self._pending:
+            return
+        frame = tuple(self._pending)
+        self._pending = []
+        if (self.compact_every is not None
+                and self.records_since >= self.compact_every):
+            self._compact()
+        else:
+            self._append_frame(frame)
+
+    def _compact(self) -> None:
+        """Snapshot the durable projection of current state into a single
+        frame, replacing the journal — with the snapshot⊕journal-suffix
+        self-check: replaying the new journal must reconstruct exactly the
+        state replaying the old one did (plus anything the pending frame
+        just folded in, i.e. current state)."""
+        snap = self._snapshot_frame()
+        self.journal = [snap]
+        self.snapshots += 1
+        self.records_since = 0
+        replayed = self._replay_journal(self.journal)
+        now = self._durable_projection()
+        if replayed != now:
+            raise _SnapshotDivergence(
+                f"snapshot replay diverges from live durable state: "
+                f"{replayed!r} != {now!r}"
+            )
+
+    def _snapshot_frame(self) -> Tuple[Tuple[Any, ...], ...]:
+        """The native ``save_snapshot`` layout: meta, todo (live queue
+        order), one lease line per held lease (sorted by task, carrying the
+        holder's cached req_id when it names this task), done, kv lines
+        (sorted)."""
+        records: List[Tuple[Any, ...]] = [("meta", self.epoch)]
+        if self.todo:
+            records.append(("todo", tuple(self.todo)))
+        req_of = {}
+        for w, (req, task) in self.acquire_cache.items():
+            req_of[(task, w)] = req
+        for task in sorted(self.leased):
+            w = self.leased[task]
+            records.append(("lease", task, w, req_of.get((task, w), "")))
+        for task in sorted(self.done):
+            records.append(("done", task))
+        for key in sorted(self.kv):
+            records.append(("kv", key, self.kv[key]))
+        return tuple(records)
+
+    def _durable_projection(self) -> Tuple[Any, ...]:
+        """The journaled slice of live state, in recovery-normal form —
+        what a crash right now must reconstruct. Note the todo ORDER is the
+        live queue order only until records replay through first-mention
+        order; the projection therefore compares sets for todo except
+        after a snapshot, where the snapshot pins live order."""
+        return (
+            self.epoch,
+            tuple(sorted(self.todo)),
+            tuple(sorted(self.leased.items())),
+            tuple(sorted(self.done)),
+            tuple(sorted(self.kv.items())),
+        )
+
+    def _replay_journal(self, frames: Sequence[Tuple[Tuple[Any, ...], ...]]):
+        """Mirror of the native ``load_state`` two-phase replay, in
+        recovery-normal form (matching ``_durable_projection``)."""
+        epoch = 0
+        todo_order: List[str] = []
+        seen: Set[str] = set()
+        lease_of: Dict[str, str] = {}
+        done: Set[str] = set()
+        kv: Dict[str, Any] = {}
+        for frame in frames:
+            for rec in frame:
+                kind = rec[0]
+                if kind == "meta":
+                    epoch = int(rec[1])
+                elif kind == "todo":
+                    for t in rec[1]:
+                        if t not in seen:
+                            seen.add(t)
+                            todo_order.append(t)
+                elif kind == "lease":
+                    t = rec[1]
+                    if t not in seen:  # lease implies the task exists
+                        seen.add(t)
+                        todo_order.append(t)
+                    lease_of[t] = rec[2]
+                elif kind == "done":
+                    done.add(rec[1])
+                elif kind == "kv":
+                    kv[rec[1]] = rec[2]
+                elif kind == "kvdel":
+                    kv.pop(rec[1], None)
+        todo = [t for t in todo_order
+                if t not in done and not lease_of.get(t)]
+        leased = {t: w for t, w in lease_of.items()
+                  if w and t not in done}
+        return (
+            epoch,
+            tuple(sorted(todo)),
+            tuple(sorted(leased.items())),
+            tuple(sorted(done)),
+            tuple(sorted(kv.items())),
+        )
+
+    def _recover(self) -> None:
+        """``load_state`` semantics on the committed journal: durable state
+        replayed, epoch bumped (a restart IS a membership event), every
+        volatile table wiped — members, barriers, sync parks, the shard
+        store and its put_id dedup (ladder honesty: gone, not lied about),
+        watch subscriptions — and the acquire req_id cache REBUILT from the
+        journaled lease records (dedup tables are durable state)."""
+        epoch = 0
+        todo_order: List[str] = []
+        seen: Set[str] = set()
+        lease_of: Dict[str, str] = {}
+        done: Set[str] = set()
+        kv: Dict[str, Any] = {}
+        cache: Dict[str, Tuple[str, str]] = {}
+        for frame in self.journal:
+            for rec in frame:
+                kind = rec[0]
+                if kind == "meta":
+                    epoch = int(rec[1])
+                elif kind == "todo":
+                    for t in rec[1]:
+                        if t not in seen:
+                            seen.add(t)
+                            todo_order.append(t)
+                elif kind == "lease":
+                    t, w = rec[1], rec[2]
+                    req = rec[3] if len(rec) > 3 else ""
+                    if t not in seen:
+                        seen.add(t)
+                        todo_order.append(t)
+                    lease_of[t] = w
+                    if w and req:
+                        cache[w] = (req, t)
+                elif kind == "done":
+                    done.add(rec[1])
+                elif kind == "kv":
+                    kv[rec[1]] = rec[2]
+                elif kind == "kvdel":
+                    kv.pop(rec[1], None)
+        self.epoch = epoch + 1
+        self.todo = [t for t in todo_order
+                     if t not in done and not lease_of.get(t)]
+        self.leased = {t: w for t, w in lease_of.items()
+                       if w and t not in done}
+        self.done = done
+        self.kv = kv
+        self.acquire_cache = cache
+        self.members = {}
+        self.next_rank = 0
+        self.barriers = {}
+        self.sync_arrived = set()
+        self.sync_generation = 0
+        self.shards = {}
+        self.shard_put_seen = set()
+        self.watch_queues = {}
+        # boot of the new incarnation: load_state queues record_epoch();
+        # crash-injection env does not survive the restart, so compaction
+        # reverts to the (never-reached) native default threshold.
+        self.compact_every = None
+        self.records_since = sum(len(f) + 1 for f in self.journal)
+        self._append_frame((("meta", self.epoch),))
+
+    def durability_counters(self) -> Dict[str, int]:
+        return {"frames": self.frames, "records": self.records_since,
+                "snapshots": self.snapshots}
+
+    def _op_crash(self, worker: str, fields: Dict[str, Any]):
+        if not self.durable:
+            raise ModelCheckError(
+                "crash op scheduled outside a durable schedule"
+            )
+        mode = fields.get("mode", "clean")
+        if mode not in CRASH_MODES:
+            raise ModelCheckError(
+                f"crash mode {mode!r} — expected one of {CRASH_MODES}"
+            )
+        inflight = fields.get("inflight") or []
+        if mode == "clean" and inflight:
+            raise ModelCheckError("crash(clean) takes no inflight ops")
+        if mode != "clean" and len(inflight) != 1:
+            raise ModelCheckError(
+                f"crash({mode}) needs exactly one inflight op (one frame)"
+            )
+        if mode != "clean" and self.compact_every is not None:
+            raise ModelCheckError(
+                f"crash({mode}) cannot combine with a compact_every "
+                "schedule — the inflight frame's append/snapshot fate "
+                "would depend on the interleaving"
+            )
+        info: Dict[str, Any] = {
+            "mode": mode,
+            "inflight": [dict(s) for s in inflight],
+            "frames_before": self.frames,
+            "records_before": self.records_since,
+            "snapshots_before": self.snapshots,
+        }
+        # Hold the apply depth up while applying the inflight op, so the
+        # nested apply() does NOT auto-commit its frame — the whole point
+        # is that this frame's fate (append / discard) is the crash mode's
+        # to decide.
+        self._apply_depth += 1
+        try:
+            for spec in inflight:
+                sub = dict(spec)
+                sub_op = sub.pop("op", "")
+                sub_worker = sub.pop("worker", worker)
+                if any(v == LAST_TASK for v in sub.values()):
+                    raise ModelCheckError(
+                        "inflight crash ops cannot use LAST_TASK"
+                    )
+                reply, released = self.apply(sub_worker, sub_op, sub)
+                if reply is None or released:
+                    raise ModelCheckError(
+                        f"inflight crash op {sub_op!r} parked or released — "
+                        "only plain request/reply ops can ride a crash frame"
+                    )
+        finally:
+            self._apply_depth -= 1
+        frame = tuple(self._pending)
+        self._pending = []
+        info["inflight_records"] = len(frame)
+        if mode == "pre_ack" and frame:
+            # appended + fsynced, reply never flushed: effects are durable.
+            # (Schedules never combine pre_ack with compact_every, so this
+            # is always an append, never a snapshot.)
+            self._append_frame(frame)
+        # torn / during_compaction: the frame never commits — recovery
+        # must show NONE of its effects. An empty frame (the inflight op
+        # deduplicated, journaling nothing) degrades every mode to clean.
+        self._recover()
+        info["epoch_after"] = self.epoch
+        self.last_crash_info = info
+        return {"ok": True, "crash": mode, "epoch": self.epoch}, []
 
     def _membership_reply(self, worker: str) -> Dict[str, Any]:
         rank = self.members.get(worker, -1)
@@ -255,6 +681,7 @@ class ProtocolModel:
         for t in stale:
             del self.leased[t]
             self.todo.append(t)
+            self._rec("lease", t, "", "")  # native: record_lease(task, "")
 
     def _release_sync_on_epoch_change(self) -> List[Tuple[str, Dict]]:
         """Membership moved (epoch already bumped): every parked sync wakes
@@ -277,6 +704,7 @@ class ProtocolModel:
             self.next_rank += 1
             if tags.get("epoch") == "bump_on_join":
                 self.epoch += 1
+                self._rec("meta", self.epoch)  # native: bump_epoch() records
                 self._notify_watchers()
                 released = self._release_sync_on_epoch_change()
         return self._membership_reply(worker), released
@@ -300,6 +728,7 @@ class ProtocolModel:
             self.next_rank = len(self.members)
             if self.effects["leave"].get("epoch") == "bump_on_drop":
                 self.epoch += 1
+                self._rec("meta", self.epoch)
                 self._notify_watchers()
             self._requeue_worker_leases(target)
             self.acquire_cache.pop(target, None)
@@ -319,12 +748,15 @@ class ProtocolModel:
         r = self._redirect(str(tasks[0]) if tasks else "")
         if r:
             return r, []
-        added = 0
+        fresh = []
         for t in fields.get("tasks", []):
             if t in self.done or t in self.leased or t in self.todo:
                 continue
             self.todo.append(t)
-            added += 1
+            fresh.append(t)
+        if fresh:  # native record_todo skips the empty list
+            self._rec("todo", tuple(fresh))
+        added = len(fresh)
         return ({"ok": True, "added": added, "queued": len(self.todo),
                  "epoch": self.epoch}, [])
 
@@ -345,6 +777,9 @@ class ProtocolModel:
                      "exhausted": not self.leased, "epoch": self.epoch}, [])
         task = self.todo.pop(0)
         self.leased[task] = worker
+        # journaling the req_id with the lease is THE durability fix for
+        # exactly-once across crash: the cache rebuilds from this record.
+        self._rec("lease", task, worker, req_id or "")
         if req_id:
             self.acquire_cache[worker] = (req_id, task)
         return {"ok": True, "task": task, "epoch": self.epoch}, []
@@ -361,6 +796,7 @@ class ProtocolModel:
             if task in self.todo:
                 self.todo.remove(task)
                 self.done.add(task)
+                self._rec("done", task)
                 return ({"ok": True, "requeued": True,
                          "done": len(self.done), "queued": len(self.todo),
                          "epoch": self.epoch}, [])
@@ -371,6 +807,7 @@ class ProtocolModel:
                      "epoch": self.epoch}, [])
         del self.leased[task]
         self.done.add(task)
+        self._rec("done", task)
         return ({"ok": True, "done": len(self.done),
                  "queued": len(self.todo), "epoch": self.epoch}, [])
 
@@ -387,6 +824,7 @@ class ProtocolModel:
                      "epoch": self.epoch}, [])
         del self.leased[task]
         self.todo.append(task)
+        self._rec("lease", task, "", "")
         return {"ok": True, "epoch": self.epoch}, []
 
     def _op_kv_put(self, worker: str, fields: Dict[str, Any]):
@@ -398,6 +836,7 @@ class ProtocolModel:
             return ({"ok": False, "error": "key required",
                      "epoch": self.epoch}, [])
         self.kv[key] = fields.get("value")
+        self._rec("kv", key, self.kv[key])
         return {"ok": True, "epoch": self.epoch}, []
 
     def _op_kv_get(self, worker: str, fields: Dict[str, Any]):
@@ -411,7 +850,10 @@ class ProtocolModel:
         r = self._redirect(fields.get("key") or "")
         if r:
             return r, []
-        self.kv.pop(fields.get("key"), None)
+        key = fields.get("key")
+        if key in self.kv:  # native records only when the erase took
+            del self.kv[key]
+            self._rec("kvdel", key)
         return {"ok": True, "epoch": self.epoch}, []
 
     def _op_kv_incr(self, worker: str, fields: Dict[str, Any]):
@@ -430,8 +872,12 @@ class ProtocolModel:
                      "duplicate": True, "epoch": self.epoch}, [])
         cur = int(self.kv.get(key, "0") or "0") + int(fields.get("delta", 1))
         self.kv[key] = str(cur)
+        # value record + marker record ride ONE frame: the torn-tail
+        # schedule exists to prove they live or die together.
+        self._rec("kv", key, str(cur))
         if marker:
             self.kv[marker] = str(cur)
+            self._rec("kv", marker, str(cur))
         return {"ok": True, "value": cur, "epoch": self.epoch}, []
 
     # Checkpoint-plane ops (memory-resident shard replication). Mirror the
@@ -526,6 +972,7 @@ class ProtocolModel:
 
     def _op_bump_epoch(self, worker: str, fields: Dict[str, Any]):
         self.epoch += 1
+        self._rec("meta", self.epoch)
         self._notify_watchers()
         released = self._release_sync_on_epoch_change()
         return {"ok": True, "epoch": self.epoch}, released
@@ -647,6 +1094,10 @@ class _Event:
     predicted: Optional[Dict[str, Any]]  # None while parked
     parked: bool = False
     released_at: Optional[int] = None  # index of the releasing event
+    #: for CRASH_OP events: the model's crash bookkeeping (mode, inflight
+    #: specs, pre-crash frame/record counters) — the oracle adapter arms
+    #: its crash injection from this.
+    crash_info: Optional[Dict[str, Any]] = None
 
 
 def _resolve_last_task(fields: Dict[str, Any], last_task: Any):
@@ -706,7 +1157,7 @@ class _TraceState:
         st.model = self.model.copy()
         st.trace = [
             _Event(e.worker, e.op, e.fields, e.predicted, e.parked,
-                   e.released_at)
+                   e.released_at, e.crash_info)
             for e in self.trace
         ]
         return st
@@ -715,10 +1166,17 @@ class _TraceState:
         """Advance ``worker`` one op through the model."""
         sop = self.scripts[worker][self.pcs[worker]]
         self.pcs[worker] += 1
+        if sop.op == CRASH_OP and self.parked:
+            raise ModelCheckError(
+                "crash scheduled while a worker is parked — durable "
+                "schedules must not mix crash with barrier/sync ops"
+            )
         fields = _resolve_last_task(sop.field_dict(), self.last_task[worker])
         predicted, released = self.model.apply(worker, sop.op, fields)
         ev = _Event(worker=worker, op=sop, fields=fields,
                     predicted=predicted, parked=predicted is None)
+        if sop.op == CRASH_OP:
+            ev.crash_info = self.model.last_crash_info
         self.trace.append(ev)
         idx = len(self.trace) - 1
         if ev.parked:
@@ -759,6 +1217,12 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
     """Execute the scheduled trace against a fresh coordinator and check
     model predictions + runtime invariants on the oracle's replies."""
     coord = factory()
+    # Oracles that must know the crash point BEFORE the first op (the
+    # native coordinator reads its crash-injection env at boot) get the
+    # whole trace up front; the in-process twin has no such hook.
+    begin = getattr(coord, "begin_trace", None)
+    if begin is not None:
+        begin(trace)
     clients = {}
     last_task: Dict[str, Any] = {}
     last_epoch: Dict[str, int] = {}
@@ -766,11 +1230,19 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
     grants_by_req: Dict[Tuple[str, str], set] = {}
     pending: Dict[int, Tuple[threading.Thread, List]] = {}
     added_total = 0
+    crashed = [False]  # flips at the first crash event in the trace
 
     def client(worker: str):
         if worker not in clients:
             clients[worker] = coord.client(worker)
         return clients[worker]
+
+    def div_kind() -> str:
+        """Model/oracle reply divergences BEFORE any crash are plain
+        spec/twin drift; AFTER a crash they mean recovery reconstructed
+        different durable state than a correct journal replay would — the
+        acked-durability invariant."""
+        return "acked-durability" if crashed[0] else "oracle-divergence"
 
     def requeue_events(worker: str, op: str, fields: Dict[str, Any]):
         """Lease-release points: a grant after one is a transfer, not a
@@ -794,7 +1266,7 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
         where = f"step {idx} ({ev.worker}:{ev.op.render()})"
         if not isinstance(reply, dict):
             violations.append(Violation(
-                "oracle-divergence",
+                div_kind(),
                 f"{where}: oracle returned non-dict reply {reply!r}",
                 rendered))
             return
@@ -805,7 +1277,7 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
                 continue  # batch sub-replies compared below
             if have != want:
                 violations.append(Violation(
-                    "oracle-divergence",
+                    div_kind(),
                     f"{where}: model predicts {key}={want!r}, oracle "
                     f"replied {key}={have!r}",
                     rendered))
@@ -814,7 +1286,7 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
             have_subs = reply.get("replies", [])
             if len(want_subs) != len(have_subs):
                 violations.append(Violation(
-                    "oracle-divergence",
+                    div_kind(),
                     f"{where}: batch sub-reply count mismatch "
                     f"(model {len(want_subs)}, oracle {len(have_subs)})",
                     rendered))
@@ -822,7 +1294,7 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
                 for key, want in ws.items():
                     if not isinstance(hs, dict) or hs.get(key, "<absent>") != want:
                         violations.append(Violation(
-                            "oracle-divergence",
+                            div_kind(),
                             f"{where} sub-op {j}: model predicts "
                             f"{key}={want!r}, oracle replied "
                             f"{(hs or {}).get(key, '<absent>')!r}",
@@ -899,6 +1371,18 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
         fields = _resolve_last_task(ev.op.field_dict(),
                                     last_task.get(ev.worker))
         oracle_fields[idx] = fields
+        if ev.op.op == CRASH_OP:
+            if not hasattr(coord, "model_crash"):
+                raise ModelCheckError(
+                    "schedule contains a crash op but the oracle factory "
+                    "built a coordinator without model_crash() — durable "
+                    "schedules need a crash-capable oracle adapter"
+                )
+            reply = coord.model_crash(ev.crash_info or {})
+            crashed[0] = True
+            clients.clear()  # old incarnation's clients are dead
+            check_reply(idx, ev, fields, reply)
+            continue
         if ev.parked or ev.released_at is not None:
             holder: List = []
 
@@ -941,6 +1425,41 @@ def _replay_trace(trace: List[_Event], factory: CoordinatorFactory,
             f"{len(pending)} parked op(s) never released by trace end",
             rendered))
 
+    close = getattr(coord, "close", None)
+    if close is not None:
+        close()  # durable oracles hold a temp state dir per replay
+
+
+def _footprint(sop: ScriptOp):
+    """Static footprint of a scripted op for the sleep-set POR. ``None``
+    means global (conflicts with every other op): epoch writers, crash,
+    batch, parked ops, the watch plane. Non-global ops commute iff their
+    footprints are disjoint — replies (epoch included: nobody here bumps
+    it) and the reached state are then identical in either order, so the
+    pruned interleaving is trace-equivalent to an explored one."""
+    op = sop.op
+    f = dict(sop.fields)
+    if op in ("ping", "members", "shard_map"):
+        return frozenset()
+    if op in ("kv_put", "kv_get", "kv_del"):
+        return frozenset({("kv", f.get("key"))})
+    if op == "kv_incr":
+        keys = {("kv", f.get("key"))}
+        if f.get("op_id"):
+            keys.add(("kv", f"__edl_op/{f.get('op_id')}"))
+        return frozenset(keys)
+    if op in ("shard_put", "shard_get", "shard_meta", "shard_drop"):
+        return frozenset({("shard", f.get("owner"))})
+    if op in ("acquire_task", "add_tasks", "complete_task", "fail_task",
+              "status"):
+        return frozenset({("queue",)})
+    return None
+
+
+def _independent(a: ScriptOp, b: ScriptOp) -> bool:
+    fa, fb = _footprint(a), _footprint(b)
+    return fa is not None and fb is not None and not (fa & fb)
+
 
 def explore(
     scripts: Dict[str, Sequence[ScriptOp]],
@@ -952,18 +1471,37 @@ def explore(
     fuzz_seed: int = 0,
     replay: bool = True,
     shard_endpoints: Optional[Sequence[str]] = None,
+    durable: bool = False,
+    compact_every: Optional[int] = None,
+    por: bool = False,
+    name: str = "",
 ) -> ModelCheckResult:
     """Enumerate interleavings of ``scripts`` (exhaustive DFS, or a seeded
     random walk when ``fuzz_samples > 0``), model-check each, and replay
     completed traces against the oracle coordinator. ``shard_endpoints``
     puts the MODEL in sharded-root mode — pair it with a factory that
-    builds the oracle with the same endpoints."""
+    builds the oracle with the same endpoints. ``durable`` runs the
+    journaled model (crash ops allowed; factory must build a crash-capable
+    oracle adapter). ``por`` turns on the sleep-set partial-order
+    reduction (exhaustive mode only; off under fuzz and compaction, where
+    frame counting makes commutation journal-visible)."""
     factory = coordinator_factory or _default_coordinator_factory
     result = ModelCheckResult()
+
+    def model() -> ProtocolModel:
+        return ProtocolModel(effects, shard_endpoints,
+                             durable=durable, compact_every=compact_every)
+
+    def annotate(start: int, state: _TraceState) -> None:
+        order = tuple(e.worker for e in state.trace)
+        for v in result.violations[start:]:
+            v.schedule = name
+            v.order = order
 
     def finish(state: _TraceState) -> None:
         result.traces += 1
         rendered = state.render()
+        start = len(result.violations)
         if not state.done():
             # all runnable workers parked / drained with parked remainder
             stuck = sorted(state.parked)
@@ -972,14 +1510,23 @@ def explore(
                 f"deadlock: worker(s) {stuck} parked with no releasing op "
                 "left in any script",
                 rendered))
+            annotate(start, state)
             return  # replay would hang on the parked ops
         if replay:
             result.replays += 1
             _replay_trace(state.trace, factory, rendered, result.violations)
+            annotate(start, state)
 
     def budget_left() -> bool:
         return (result.traces < max_traces
                 and len(result.violations) < max_violations)
+
+    def snapshot_diverged(state: _TraceState, exc: _SnapshotDivergence):
+        result.traces += 1
+        start = len(result.violations)
+        result.violations.append(Violation(
+            "snapshot-divergence", str(exc), state.render()))
+        annotate(start, state)
 
     if fuzz_samples > 0:
         import random
@@ -989,13 +1536,20 @@ def explore(
         for _ in range(fuzz_samples):
             if not budget_left():
                 break
-            state = _TraceState(
-                scripts, ProtocolModel(effects, shard_endpoints))
+            state = _TraceState(scripts, model())
+            diverged = False
             while True:
                 workers = state.runnable()
                 if not workers:
                     break
-                state.step(rng.choice(workers))
+                try:
+                    state.step(rng.choice(workers))
+                except _SnapshotDivergence as exc:
+                    snapshot_diverged(state, exc)
+                    diverged = True
+                    break
+            if diverged:
+                continue
             key = state.render()
             if key in seen:
                 continue
@@ -1003,21 +1557,44 @@ def explore(
             finish(state)
         return result
 
-    def dfs(state: _TraceState) -> None:
+    use_por = por and compact_every is None
+
+    def next_op(state: _TraceState, worker: str) -> ScriptOp:
+        return state.scripts[worker][state.pcs[worker]]
+
+    def dfs(state: _TraceState, sleep: frozenset) -> None:
         if not budget_left():
             return
         workers = state.runnable()
         if not workers:
             finish(state)
             return
-        for i, worker in enumerate(workers):
-            branch = state if i == len(workers) - 1 else state.copy()
-            branch.step(worker)
-            dfs(branch)
+        active = [w for w in workers if w not in sleep]
+        if not active:
+            return  # every continuation is covered by an explored sibling
+        explored: List[str] = []
+        for i, worker in enumerate(active):
+            branch = state if i == len(active) - 1 else state.copy()
+            if use_por:
+                here = next_op(state, worker)
+                child_sleep = frozenset(
+                    v for v in (set(sleep) | set(explored))
+                    if v != worker and v in workers
+                    and _independent(next_op(state, v), here)
+                )
+            else:
+                child_sleep = frozenset()
+            try:
+                branch.step(worker)
+            except _SnapshotDivergence as exc:
+                snapshot_diverged(branch, exc)
+            else:
+                dfs(branch, child_sleep)
+            explored.append(worker)
             if not budget_left():
                 return
 
-    dfs(_TraceState(scripts, ProtocolModel(effects, shard_endpoints)))
+    dfs(_TraceState(scripts, model()), frozenset())
     return result
 
 
@@ -1146,29 +1723,337 @@ def _sharded_root_factory():
                                 shard_endpoints=list(SHARD_ENDPOINTS))
 
 
+# -- durable oracle adapter ------------------------------------------------------
+
+
+def _truncate_torn_tail(path: str) -> None:
+    """Tear the journal's final frame: drop its commit-marker line (it
+    never reached disk) and cut the last data record in half, leaving an
+    unparseable tail — the on-disk shape of a crash mid-``fwrite``."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return
+    while lines and not lines[-1].strip():
+        lines.pop()
+    if not lines:
+        return
+    last = lines.pop()
+    try:
+        is_marker = json.loads(last).get("k") == "c"
+    except ValueError:
+        is_marker = False
+    if not is_marker:
+        lines.append(last)  # already torn; just halve the data record
+    if lines:
+        lines[-1] = lines[-1][: max(1, len(lines[-1]) // 2)]
+    with open(path, "w", encoding="utf-8") as f:
+        for ln in lines:
+            f.write(ln + "\n")
+
+
+class DurableTwinOracle:
+    """Crash-capable oracle adapter for durable schedules: an
+    ``InProcessCoordinator`` with its state-file persistence twin enabled,
+    plus ``model_crash()`` — the oracle realization of the model's crash
+    pseudo-op. ``clean`` reboots from the state file; ``pre_ack`` applies
+    the inflight op (its frame commits) and discards the reply; ``torn``
+    applies the inflight op then tears the journal tail; and
+    ``during_compaction`` arms the crash-before-commit hook so the inflight
+    frame never reaches disk. ``skip_tail_scan`` is the EDL010 mutant
+    knob: recovery skips torn-tail detection, replaying partial frames —
+    which the acked-durability invariant must catch."""
+
+    def __init__(self, compact_every: Optional[int] = None,
+                 skip_tail_scan: bool = False,
+                 disable_dedup: bool = False):
+        self._dir = tempfile.mkdtemp(prefix="edl-modelcheck-")
+        self._finalizer = weakref.finalize(
+            self, shutil.rmtree, self._dir, True)
+        self._path = os.path.join(self._dir, "coordinator_state.jsonl")
+        self._skip_tail_scan = skip_tail_scan
+        self._disable_dedup = disable_dedup
+        self._coord = self._boot(compact_every)
+
+    def _boot(self, compact_every: Optional[int]):
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        c = InProcessCoordinator(
+            task_lease_sec=1e9, heartbeat_ttl_sec=1e9,
+            state_file=self._path, run_id="modelcheck",
+            compact_every=compact_every,
+            skip_tail_commit_scan=self._skip_tail_scan,
+        )
+        if self._disable_dedup:
+            c._test_disable_dedup = True
+        return c
+
+    def client(self, worker: str):
+        return self._coord.client(worker)
+
+    def model_crash(self, info: Dict[str, Any]) -> Dict[str, Any]:
+        mode = info.get("mode", "clean")
+        for spec in info.get("inflight", []):
+            sub = dict(spec)
+            sub_op = sub.pop("op", "")
+            sub_worker = sub.pop("worker", "__crash__")
+            if mode == "during_compaction":
+                self._coord._test_crash_before_commit = True
+            self._coord.client(sub_worker).call(sub_op, **sub)  # reply lost
+        if mode == "torn" and info.get("inflight_records", 0) > 0:
+            _truncate_torn_tail(self._path)
+        # reboot: a fresh incarnation recovering from the state file. The
+        # crash-injection env never survives a restart, so neither does a
+        # compaction override.
+        self._coord = self._boot(compact_every=None)
+        status = self._coord.client("__crash__").call("status")
+        return {"ok": True, "crash": mode, "epoch": status.get("epoch")}
+
+    def close(self) -> None:
+        self._finalizer()
+
+
+def _durable_twin_factory():
+    return DurableTwinOracle()
+
+
+#: compaction threshold (journal lines incl. commit markers) for the
+#: durability-compact schedule — low enough that most interleavings snapshot
+#: mid-trace. The model and the twin count records identically.
+_COMPACT_EVERY = 6
+
+
+def _durable_compact_twin_factory():
+    return DurableTwinOracle(compact_every=_COMPACT_EVERY)
+
+
+def _native_oracle_factory():
+    from edl_tpu.analysis.native_oracle import NativeCrashOracle
+
+    return NativeCrashOracle()
+
+
+def _native_compact_oracle_factory():
+    from edl_tpu.analysis.native_oracle import NativeCrashOracle
+
+    return NativeCrashOracle(compact_every=_COMPACT_EVERY)
+
+
+def durability_base_scripts() -> Dict[str, List[ScriptOp]]:
+    """Durability base schedule: a clean crash interleaved through the
+    journaled op set — the DFS position of the crash op enumerates every
+    crash point. Post-crash: the duplicate acquire must return the
+    original lease (req_id dedup cache rebuilt from journaled lease
+    records) and the completed task must conserve."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("add_tasks", tasks=["d0", "d1"]),
+        mk("acquire_task", req_id="w0-a1", worker="w0"),
+        mk("crash", mode="clean", worker="w0"),
+        mk("acquire_task", note="dup", req_id="w0-a1", worker="w0"),
+        mk("complete_task", task=LAST_TASK, worker="w0"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("kv_put", key="alpha", value="1"),
+        mk("kv_incr", key="steps", delta=1, op_id="w1-i1"),
+        mk("kv_get", key="alpha"),
+        mk("kv_del", key="alpha"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def durability_dedup_scripts() -> Dict[str, List[ScriptOp]]:
+    """Post-fsync survival (``pre_ack``): the inflight kv_put's frame is
+    fsynced but its reply never flushes — recovery must show the value.
+    The duplicate acquire and duplicate kv_incr straddle the crash point
+    in some interleavings: exactly-once across crash."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("add_tasks", tasks=["d0", "d1"]),
+        mk("acquire_task", req_id="w0-a1", worker="w0"),
+        mk("crash", mode="pre_ack", worker="w0",
+           inflight=[{"op": "kv_put", "key": "ck", "value": "committed"}]),
+        mk("acquire_task", note="dup", req_id="w0-a1", worker="w0"),
+        mk("kv_get", key="ck"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("kv_incr", key="steps", delta=1, op_id="w1-i1"),
+        mk("kv_incr", note="dup", key="steps", delta=1, op_id="w1-i1"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def durability_torn_scripts() -> Dict[str, List[ScriptOp]]:
+    """Pre-fsync loss (``torn``): the inflight kv_incr writes its value
+    record and its op_id marker record into ONE frame, and the tail is
+    torn mid-write — recovery must drop the whole frame (all-or-nothing),
+    so the post-crash retry applies exactly once. A twin that skips
+    torn-tail detection replays the value without the marker and
+    double-applies: the mutant-teeth scenario."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("kv_incr", key="steps", delta=1, op_id="w0-i1"),
+        mk("crash", mode="torn", worker="w0",
+           inflight=[{"op": "kv_incr", "key": "steps", "delta": 1,
+                      "op_id": "w0-i2"}]),
+        mk("kv_incr", note="retry", key="steps", delta=1, op_id="w0-i2"),
+        mk("kv_get", key="steps"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("kv_put", key="alpha", value="1"),
+        mk("kv_get", key="alpha"),
+        mk("kv_del", key="alpha"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def durability_compact_scripts() -> Dict[str, List[ScriptOp]]:
+    """Snapshot/compaction schedule (``compact_every=_COMPACT_EVERY``):
+    most interleavings cross the threshold mid-trace, so the model's
+    snapshot⊕journal-suffix self-check runs at a different point per
+    interleaving, and the clean crash recovers from snapshot + suffix.
+    POR is off here: frame counting makes commutation journal-visible."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("add_tasks", tasks=["c0", "c1"]),
+        mk("acquire_task", req_id="w0-a1", worker="w0"),
+        mk("complete_task", task=LAST_TASK, worker="w0"),
+        mk("crash", mode="clean", worker="w0"),
+        mk("kv_get", key="a"),
+        mk("kv_incr", key="steps", delta=1, op_id="w0-i1"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("kv_put", key="a", value="1"),
+        mk("kv_incr", key="steps", delta=1, op_id="w1-i1"),
+        mk("kv_put", key="b", value="2"),
+        mk("kv_del", key="b"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def durability_crash_compact_scripts() -> Dict[str, List[ScriptOp]]:
+    """Crash during compaction: the inflight kv_put triggers a snapshot
+    that dies after the tmp write, before the rename — the journal is
+    untouched and the inflight effects are lost, unacked. Recovery must
+    show the pre-crash journal state exactly."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("kv_put", key="s1", value="v1"),
+        mk("crash", mode="during_compaction", worker="w0",
+           inflight=[{"op": "kv_put", "key": "s2", "value": "v2"}]),
+        mk("kv_get", key="s2"),
+        mk("kv_get", key="s1"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("add_tasks", tasks=["x0"]),
+        mk("acquire_task", req_id="w1-a1", worker="w1"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+def durability_shard_scripts() -> Dict[str, List[ScriptOp]]:
+    """Ladder honesty for the deliberately-unjournaled shard store: a
+    crash loses the blobs AND the put_id dedup table, so a replayed
+    shard_put re-stores (duplicate=False) instead of lying about
+    durability — its loss costs a recovery rung, never contradicts an
+    ack."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("shard_put", owner="w0", step=1, chunk=0, chunks=1, nbytes=4,
+           data="AAAA", put_id="w0-p1", group=["w1"]),
+        mk("crash", mode="clean", worker="w0"),
+        mk("shard_put", note="dup", owner="w0", step=1, chunk=0, chunks=1,
+           nbytes=4, data="AAAA", put_id="w0-p1", group=["w1"]),
+        mk("shard_meta", owner="w0"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("shard_get", owner="w0", step=-1, chunk=0),
+        mk("kv_put", key="k", value="v1"),
+        mk("shard_meta", owner="w0"),
+        mk("shard_get", owner="w0", step=-1, chunk=0),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
+@dataclass
+class Schedule:
+    """One named row of the acceptance configuration: scripts + the oracle
+    factory + the model knobs. ``default_schedules`` returns these;
+    ``run_default`` explores each and merges results."""
+
+    name: str
+    scripts: Dict[str, List[ScriptOp]]
+    factory: Optional[CoordinatorFactory] = None
+    shard_endpoints: Optional[List[str]] = None
+    durable: bool = False
+    compact_every: Optional[int] = None
+    por: bool = False
+
+
+def durability_schedules() -> List[Schedule]:
+    """The EDL010 rows: every journaled op crossed with enumerated crash
+    points, plus the shard-store (unjournaled) schedule — all replayed
+    against the file-backed persistence twin."""
+    return [
+        Schedule("durability-base", durability_base_scripts(),
+                 _durable_twin_factory, durable=True, por=True),
+        Schedule("durability-dedup", durability_dedup_scripts(),
+                 _durable_twin_factory, durable=True, por=True),
+        Schedule("durability-torn", durability_torn_scripts(),
+                 _durable_twin_factory, durable=True, por=True),
+        Schedule("durability-compact", durability_compact_scripts(),
+                 _durable_compact_twin_factory, durable=True,
+                 compact_every=_COMPACT_EVERY, por=False),
+        Schedule("durability-crash-compact",
+                 durability_crash_compact_scripts(),
+                 _durable_twin_factory, durable=True, por=True),
+        Schedule("durability-shard", durability_shard_scripts(),
+                 _durable_twin_factory, durable=True, por=True),
+    ]
+
+
 def default_schedules(
     coordinator_factory: Optional[CoordinatorFactory] = None,
-) -> List[Tuple[Dict[str, List[ScriptOp]],
-                Optional[CoordinatorFactory],
-                Optional[List[str]]]]:
-    """The acceptance schedules as (scripts, factory, shard_endpoints)
-    rows — explored separately so each stays inside the interleaving
-    budget; results merge. With a caller-supplied ``coordinator_factory``
-    (the broken-twin tests) the redirect schedule runs UNSHARDED against
-    that factory: routing is only modeled when we also control the oracle's
-    shard configuration."""
-    rows: List[Tuple[Dict[str, List[ScriptOp]],
-                     Optional[CoordinatorFactory],
-                     Optional[List[str]]]] = [
-        (default_scripts(), coordinator_factory, None),
-        (ckpt_plane_scripts(), coordinator_factory, None),
-        (watch_scripts(), coordinator_factory, None),
+) -> List[Schedule]:
+    """The acceptance schedules — explored separately so each stays inside
+    the interleaving budget; results merge. With a caller-supplied
+    ``coordinator_factory`` (the broken-twin tests) the redirect schedule
+    runs UNSHARDED against that factory, and the durability rows are
+    dropped entirely: a caller's factory has neither the persistence twin
+    nor ``model_crash`` (durable mutants use ``explore`` directly with a
+    ``DurableTwinOracle`` variant)."""
+    rows = [
+        Schedule("default", default_scripts(), coordinator_factory),
+        Schedule("ckpt-plane", ckpt_plane_scripts(), coordinator_factory),
+        Schedule("watch", watch_scripts(), coordinator_factory),
     ]
     if coordinator_factory is None:
-        rows.append((watch_redirect_scripts(), _sharded_root_factory,
-                     list(SHARD_ENDPOINTS)))
+        rows.append(Schedule("watch-redirect", watch_redirect_scripts(),
+                             _sharded_root_factory,
+                             shard_endpoints=list(SHARD_ENDPOINTS)))
+        rows.extend(durability_schedules())
     else:
-        rows.append((watch_redirect_scripts(), coordinator_factory, None))
+        rows.append(Schedule("watch-redirect", watch_redirect_scripts(),
+                             coordinator_factory))
     return rows
 
 
@@ -1199,26 +2084,151 @@ def run_default(
     fuzz_seed: int = 0,
     max_traces: int = 20000,
     max_violations: int = 25,
+    schedules: Optional[Sequence[str]] = None,
+    native: bool = False,
 ) -> ModelCheckResult:
+    """Explore the default schedule set (optionally filtered to the named
+    ``schedules``) and merge results. ``result.timings`` carries one
+    (name, traces, seconds) row per schedule.
+
+    ``native=True`` swaps the durability rows' oracle for the crash-armed
+    ``edl-coordinator`` subprocess (``NativeCrashOracle``) and drops the
+    non-durable rows: each trace then boots/kills/restarts a real server,
+    so only the crash-recovery lanes are worth the wall-clock."""
     if effects is None:
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         effects, _ops, err = load_state_effects(root)
         if err:
             raise ModelCheckError(err)
+    rows = default_schedules(coordinator_factory)
+    if native:
+        rows = [
+            _dc_replace(
+                s, factory=(_native_compact_oracle_factory
+                            if s.compact_every else _native_oracle_factory))
+            for s in rows if s.durable
+        ]
+    if schedules is not None:
+        known = {s.name for s in rows}
+        unknown = set(schedules) - known
+        if unknown:
+            raise ModelCheckError(
+                f"unknown schedule(s) {sorted(unknown)} — "
+                f"known: {sorted(known)}"
+            )
+        rows = [s for s in rows if s.name in set(schedules)]
     result = ModelCheckResult()
-    for scripts, factory, endpoints in default_schedules(coordinator_factory):
+    for sched in rows:
+        t0 = time.monotonic()
         extra = explore(
-            scripts, effects,
-            coordinator_factory=factory,
+            sched.scripts, effects,
+            coordinator_factory=sched.factory,
             fuzz_samples=fuzz_samples, fuzz_seed=fuzz_seed,
             max_traces=max_traces, max_violations=max_violations,
-            shard_endpoints=endpoints,
+            shard_endpoints=sched.shard_endpoints,
+            durable=sched.durable, compact_every=sched.compact_every,
+            por=sched.por, name=sched.name,
         )
         result.traces += extra.traces
         result.replays += extra.replays
         result.violations.extend(extra.violations)
+        result.timings.append(
+            (sched.name, extra.traces, time.monotonic() - t0))
     return result
+
+
+# -- trace spec round-trip (--dump-trace / --replay-trace) -----------------------
+
+
+def dump_trace_spec(v: Violation,
+                    schedules: Optional[List[Schedule]] = None
+                    ) -> Dict[str, Any]:
+    """Serialize a violating interleaving as a self-contained JSON spec
+    (same round-trip discipline as ChaosScenario): the schedule's scripts,
+    the exact worker step order, and the model knobs needed to re-create
+    the run in isolation."""
+    rows = schedules if schedules is not None else default_schedules()
+    sched = next((s for s in rows if s.name == v.schedule), None)
+    if sched is None:
+        raise ModelCheckError(
+            f"violation carries no known schedule name ({v.schedule!r}) — "
+            "only violations from named schedules can be dumped"
+        )
+    return {
+        "schedule": sched.name,
+        "kind": v.kind,
+        "message": v.message,
+        "order": list(v.order),
+        "scripts": {
+            w: [{"op": s.op, "note": s.note, "fields": s.field_dict()}
+                for s in ops]
+            for w, ops in sched.scripts.items()
+        },
+        "durable": sched.durable,
+        "compact_every": sched.compact_every,
+        "shard_endpoints": sched.shard_endpoints,
+    }
+
+
+def _factory_for_spec(spec: Dict[str, Any]) -> CoordinatorFactory:
+    if spec.get("durable"):
+        compact = spec.get("compact_every")
+        return lambda: DurableTwinOracle(compact_every=compact)
+    endpoints = spec.get("shard_endpoints")
+    if endpoints:
+        from edl_tpu.coordinator.inprocess import InProcessCoordinator
+
+        return lambda: InProcessCoordinator(
+            task_lease_sec=1e9, heartbeat_ttl_sec=1e9,
+            shard_endpoints=list(endpoints))
+    return _default_coordinator_factory
+
+
+def replay_trace_spec(
+    spec: Dict[str, Any],
+    effects: Dict[str, Dict[str, Any]],
+    coordinator_factory: Optional[CoordinatorFactory] = None,
+) -> List[Violation]:
+    """Re-execute one dumped interleaving — the exact step order, no
+    exploration — through the model and against the oracle; returns the
+    violations it reproduces."""
+    scripts = {
+        w: [ScriptOp.make(e["op"], e.get("note", ""),
+                          **(e.get("fields") or {}))
+            for e in ops]
+        for w, ops in spec.get("scripts", {}).items()
+    }
+    model = ProtocolModel(
+        effects, spec.get("shard_endpoints"),
+        durable=bool(spec.get("durable")),
+        compact_every=spec.get("compact_every"))
+    state = _TraceState(scripts, model)
+    violations: List[Violation] = []
+    for w in spec.get("order", []):
+        try:
+            state.step(w)
+        except _SnapshotDivergence as exc:
+            violations.append(Violation(
+                "snapshot-divergence", str(exc), state.render(),
+                schedule=spec.get("schedule", ""),
+                order=tuple(spec.get("order", []))))
+            return violations
+    rendered = state.render()
+    if not state.done():
+        violations.append(Violation(
+            "progress",
+            f"deadlock: worker(s) {sorted(state.parked)} parked at spec "
+            "end",
+            rendered, schedule=spec.get("schedule", ""),
+            order=tuple(spec.get("order", []))))
+        return violations
+    factory = coordinator_factory or _factory_for_spec(spec)
+    _replay_trace(state.trace, factory, rendered, violations)
+    for v in violations:
+        v.schedule = spec.get("schedule", "")
+        v.order = tuple(spec.get("order", []))
+    return violations
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -1243,28 +2253,107 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="exploration budget (default: 20000)")
     parser.add_argument("--json", action="store_true",
                         help="machine-readable result")
+    parser.add_argument(
+        "--schedules", default=None, metavar="NAME,...",
+        help="comma-separated schedule filter (e.g. "
+             "durability-base,durability-torn); default: all")
+    parser.add_argument(
+        "--dump-trace", default=None, metavar="PATH",
+        help="on the first violation, write the interleaving as a JSON "
+             "spec replayable with --replay-trace")
+    parser.add_argument(
+        "--replay-trace", default=None, metavar="PATH",
+        help="re-execute one dumped trace spec in isolation instead of "
+             "exploring")
+    parser.add_argument(
+        "--timings", action="store_true",
+        help="print a per-schedule (traces, seconds) split")
+    parser.add_argument(
+        "--native", action="store_true",
+        help="replay the durability schedules against the crash-armed "
+             "native edl-coordinator binary instead of the in-process "
+             "persistence twin (drops the non-durable schedules; exits 0 "
+             "with a notice when no C++ toolchain is on PATH)")
     args = parser.parse_args(argv)
 
+    if args.replay_trace:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        effects, _ops, err = load_state_effects(root)
+        if err:
+            print(f"modelcheck: {err}")
+            return 2
+        with open(args.replay_trace, "r", encoding="utf-8") as f:
+            spec = json.load(f)
+        violations = replay_trace_spec(spec, effects)
+        print(
+            f"modelcheck [replay {spec.get('schedule', '?')}]: 1 trace, "
+            f"{len(violations)} violation(s)"
+        )
+        for v in violations:
+            print(f"  [{v.kind}] {v.message}")
+            print(f"    trace: {v.trace}")
+        return 0 if not violations else 1
+
+    schedules = None
+    if args.schedules:
+        schedules = [s.strip() for s in args.schedules.split(",")
+                     if s.strip()]
+    if args.native:
+        from edl_tpu.analysis.native_oracle import native_toolchain_available
+
+        if not native_toolchain_available():
+            print("modelcheck [native]: no C++ toolchain on PATH — "
+                  "native-oracle lane skipped")
+            return 0
+        from edl_tpu.coordinator.server import CoordinatorError, ensure_built
+
+        try:
+            ensure_built()
+        except CoordinatorError as e:
+            print(f"modelcheck [native]: coordinator build failed: {e}")
+            return 2
     result = run_default(
         fuzz_samples=args.fuzz, fuzz_seed=args.seed,
         max_traces=args.max_traces,
+        schedules=schedules,
+        native=args.native,
     )
+    if args.dump_trace and result.violations:
+        spec = dump_trace_spec(result.violations[0])
+        with open(args.dump_trace, "w", encoding="utf-8") as f:
+            json.dump(spec, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"modelcheck: first violating trace dumped to "
+              f"{args.dump_trace}")
     if args.json:
         print(json.dumps({
             "traces": result.traces,
             "replays": result.replays,
+            "timings": [
+                {"schedule": n, "traces": t, "seconds": round(s, 3)}
+                for n, t, s in result.timings
+            ],
             "violations": [
-                {"kind": v.kind, "message": v.message, "trace": v.trace}
+                {"kind": v.kind, "message": v.message, "trace": v.trace,
+                 "schedule": v.schedule}
                 for v in result.violations
             ],
         }, indent=2))
     else:
         mode = f"fuzz({args.fuzz}, seed={args.seed})" if args.fuzz else "exhaustive"
+        if args.native:
+            mode += ", native"
+        oracle = ("crash-armed edl-coordinator" if args.native
+                  else "InProcessCoordinator")
         print(
             f"modelcheck [{mode}]: {result.traces} trace(s) explored, "
-            f"{result.replays} replayed against InProcessCoordinator, "
+            f"{result.replays} replayed against {oracle}, "
             f"{len(result.violations)} violation(s)"
         )
+        if args.timings:
+            for n, t, s in result.timings:
+                print(f"  {n}: {t} trace(s) in {s:.2f}s")
         for v in result.violations:
             print(f"  [{v.kind}] {v.message}")
             print(f"    trace: {v.trace}")

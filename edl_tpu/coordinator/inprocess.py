@@ -12,6 +12,7 @@ Condition instead of a parked socket.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 import uuid
@@ -25,7 +26,11 @@ class InProcessCoordinator:
     def __init__(self, task_lease_sec: float = 16.0,
                  heartbeat_ttl_sec: float = 10.0,
                  auth_token: Optional[str] = None,
-                 shard_endpoints: Optional[List[str]] = None):
+                 shard_endpoints: Optional[List[str]] = None,
+                 state_file: Optional[str] = None,
+                 run_id: Optional[str] = None,
+                 compact_every: Optional[int] = None,
+                 skip_tail_commit_scan: bool = False):
         self.task_lease_sec = task_lease_sec
         self.heartbeat_ttl_sec = heartbeat_ttl_sec
         #: per-job shared secret, same contract as the native binary's
@@ -71,12 +76,227 @@ class InProcessCoordinator:
         # deliberately-broken twin to prove a dedup regression is caught.
         # Never set outside tests.
         self._test_disable_dedup = False
-        # Native-parity status counters. fsync/snapshot/journal counters stay
-        # zero (there is no journal in-process) but the fields must exist so
-        # status replies are field-identical across backends (EDL007).
+        # Native-parity status counters. Without a state file the journal
+        # trio stays zero, but the fields must exist so status replies are
+        # field-identical across backends (EDL007).
         self._ops_count = 0
         self._batch_frames = 0
         self._batch_subops = 0
+        # State-file persistence twin (EDL010): a JSONL group-commit journal
+        # mirroring the native server's — same record vocabulary (meta /
+        # todo / done / lease / kv / kvdel), one frame per event-loop turn,
+        # each frame closed by a {"k":"c"} commit-marker line. Recovery
+        # replays only the committed prefix (everything after the last
+        # marker is a torn tail and is truncated away), restores leases
+        # under their holders, rebuilds the acquire req_id cache from the
+        # journaled lease records, and bumps the epoch.
+        self._state_file = state_file
+        self._run_id = run_id or ""
+        self._compact_every = compact_every
+        # Test-only mutant hook (EDL010 teeth): skip the tail-commit scan
+        # during recovery, replaying partial frames the way the journal
+        # format's silent-skip predecessor did. Never set outside tests.
+        self._skip_tail_commit_scan = skip_tail_commit_scan
+        # Test-only crash hook: the next frame commit is dropped on the
+        # floor (the on-disk effect of dying inside a snapshot write,
+        # after the tmp write and before the rename).
+        self._test_crash_before_commit = False
+        self._pending_records: List[str] = []
+        self._turn_depth = 0  # >0: a batch frame is open; defer commits
+        self._fsyncs = 0
+        self._snapshots = 0
+        self._records_since = 0  # journal lines since last snapshot
+        if self._state_file:
+            self._load_state()
+
+    # -- state-file persistence (the EDL010 twin journal) ----------------------
+
+    def _record(self, obj: Dict) -> None:
+        if self._state_file:
+            self._pending_records.append(json.dumps(obj, sort_keys=True))
+
+    def _record_epoch(self) -> None:
+        self._record({"k": "meta", "epoch": self._epoch,
+                      "run_id": self._run_id})
+
+    def _record_todo(self, tasks: List[str]) -> None:
+        if tasks:  # native parity: the empty list is not journaled
+            self._record({"k": "todo", "tasks": list(tasks)})
+
+    def _record_done(self, task: str) -> None:
+        self._record({"k": "done", "tasks": [task]})
+
+    def _record_lease(self, task: str, worker: str,
+                      req_id: str = "") -> None:
+        self._record({"k": "lease", "task": task, "worker": worker,
+                      "req_id": req_id})
+
+    def _record_kv(self, key: str) -> None:
+        self._record({"k": "kv", "key": key,
+                      "value": self._kv.get(key, "")})
+
+    def _record_kv_del(self, key: str) -> None:
+        self._record({"k": "kvdel", "key": key})
+
+    def _append_frame(self, lines: List[str]) -> None:
+        with open(self._state_file, "a", encoding="utf-8") as f:
+            for line in lines:
+                f.write(line + "\n")
+            f.write('{"k": "c"}\n')  # the frame's commit marker
+            f.flush()
+        self._fsyncs += 1
+        self._records_since += len(lines) + 1
+
+    def _commit(self) -> None:
+        """Group-commit the turn's records: one append + one fsync per
+        event-loop turn — or a snapshot when past the compaction threshold
+        (checked BEFORE appending, the native ``maybe_save_state`` shape;
+        the snapshot covers the pending effects because in-memory state
+        already has them)."""
+        if not self._state_file or not self._pending_records:
+            return
+        if self._turn_depth > 0:
+            return  # a batch frame is open: sub-op records ride it
+        if self._test_crash_before_commit:
+            # dying inside the snapshot write, before the rename: the
+            # journal is untouched and the frame never reaches disk.
+            self._test_crash_before_commit = False
+            self._pending_records = []
+            return
+        pending = self._pending_records
+        self._pending_records = []
+        if (self._compact_every is not None
+                and self._records_since >= self._compact_every):
+            self._save_snapshot()
+        else:
+            self._append_frame(pending)
+
+    def _save_snapshot(self) -> None:
+        """Native ``save_snapshot`` layout: meta, todo (live queue order),
+        one lease line per held lease (carrying the holder's cached req_id
+        when it names this task), done, kv — tmp write + rename."""
+        recs: List[Dict] = [{"k": "meta", "epoch": self._epoch,
+                             "run_id": self._run_id}]
+        if self._todo:
+            recs.append({"k": "todo", "tasks": list(self._todo)})
+        req_of = {}
+        for w, (req, task) in self._acquire_cache.items():
+            req_of[(task, w)] = req
+        for task in sorted(self._leased):
+            w = self._leased[task]["worker"]
+            recs.append({"k": "lease", "task": task, "worker": w,
+                         "req_id": req_of.get((task, w), "")})
+        for task in sorted(self._done):
+            recs.append({"k": "done", "tasks": [task]})
+        for key in sorted(self._kv):
+            recs.append({"k": "kv", "key": key, "value": self._kv[key]})
+        tmp = self._state_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for rec in recs:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+            f.write('{"k": "c"}\n')
+            f.flush()
+        os.replace(tmp, self._state_file)
+        self._snapshots += 1
+        self._records_since = 0
+
+    def _load_state(self) -> None:
+        """Recovery replay (native ``load_state`` semantics): replay the
+        committed journal prefix, restore leases under their holders,
+        rebuild the acquire req_id dedup cache from the journaled lease
+        records, bump the epoch (a restart IS a membership event), and
+        truncate any torn tail away on disk."""
+        try:
+            with open(self._state_file, "r", encoding="utf-8") as f:
+                raw = [ln for ln in f.read().splitlines() if ln.strip()]
+        except OSError:
+            self._boot_frame()
+            return
+        # Tail-commit scan: only the prefix up to the last {"k":"c"} marker
+        # is durable; everything after it is a torn frame and must be
+        # dropped WHOLE (all-or-nothing is the frame contract). Files from
+        # the pre-marker format (no "c" records at all) are taken whole.
+        committed = raw
+        if not self._skip_tail_commit_scan:
+            last_c = -1
+            for i, line in enumerate(raw):
+                try:
+                    if json.loads(line).get("k") == "c":
+                        last_c = i
+                except ValueError:
+                    continue
+            if last_c >= 0:
+                committed = raw[: last_c + 1]
+        epoch = 0
+        todo_order: List[str] = []
+        seen: Set[str] = set()
+        lease_of: Dict[str, str] = {}
+        cache: Dict[str, tuple] = {}
+        done: Set[str] = set()
+        kv: Dict[str, str] = {}
+        for line in committed:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # mutant/legacy lane: unparseable lines skipped
+            kind = rec.get("k")
+            if kind == "meta":
+                if self._run_id and rec.get("run_id") \
+                        and rec["run_id"] != self._run_id:
+                    # another run's journal: discard it, start fresh
+                    open(self._state_file, "w", encoding="utf-8").close()
+                    self._records_since = 0
+                    self._boot_frame()
+                    return
+                epoch = int(rec.get("epoch", 0))
+            elif kind == "todo":
+                for t in rec.get("tasks", []):
+                    if t not in seen:
+                        seen.add(t)
+                        todo_order.append(t)
+            elif kind == "done":
+                for t in rec.get("tasks", []):
+                    done.add(t)
+            elif kind == "lease":
+                t, w = rec.get("task", ""), rec.get("worker", "")
+                if t and t not in seen:  # a lease implies the task exists
+                    seen.add(t)
+                    todo_order.append(t)
+                lease_of[t] = w
+                if w and rec.get("req_id"):
+                    cache[w] = (rec["req_id"], t)
+            elif kind == "kv":
+                kv[rec.get("key", "")] = rec.get("value", "")
+            elif kind == "kvdel":
+                kv.pop(rec.get("key", ""), None)
+        if len(committed) != len(raw):
+            with open(self._state_file, "w", encoding="utf-8") as f:
+                for line in committed:
+                    f.write(line + "\n")
+        self._epoch = epoch + 1
+        now = time.monotonic()
+        self._todo = deque(
+            t for t in todo_order if t not in done and not lease_of.get(t))
+        self._leased = {
+            t: {"worker": w, "deadline": now + self.task_lease_sec}
+            for t, w in lease_of.items() if w and t not in done
+        }
+        self._done = set(done)
+        self._kv = dict(kv)
+        self._acquire_cache = dict(cache)
+        self._records_since = len(committed)
+        self._boot_frame()
+
+    def _boot_frame(self) -> None:
+        """A fresh incarnation's first frame: the epoch meta record. The
+        native server queues it in load_state and flushes on the next
+        turn; the twin flushes synchronously so the file always names the
+        live epoch. Bypasses compaction (matching the model's recovery)."""
+        self._record_epoch()
+        lines = self._pending_records
+        self._pending_records = []
+        if lines:
+            self._append_frame(lines)
 
     # -- expiry ---------------------------------------------------------------
 
@@ -92,6 +312,11 @@ class InProcessCoordinator:
         for t in expired:
             del self._leased[t]
             self._todo.append(t)
+            self._record_lease(t, "", "")
+        if dead or expired:
+            # expiry is its own event-loop turn (native: tick()), so its
+            # records commit as their own frame, not the caller op's.
+            self._commit()
 
     def _drop_member(self, name: str) -> None:
         if name not in self._members:
@@ -102,6 +327,7 @@ class InProcessCoordinator:
             m["rank"] = r
         self._next_rank = len(self._members)
         self._epoch += 1
+        self._record_epoch()
         self._notify_watchers()
         self._requeue_worker_leases(name)
         self._acquire_cache.pop(name, None)
@@ -147,11 +373,13 @@ class InProcessCoordinator:
                 }
                 self._next_rank += 1
                 self._epoch += 1
+                self._record_epoch()
                 self._notify_watchers()
                 self._release_sync()
             else:
                 self._members[worker]["last_heartbeat"] = time.monotonic()
                 self._renew_leases(worker)
+            self._commit()
             return self._membership_reply(worker)
 
     def _requeue_worker_leases(self, worker: str) -> None:
@@ -159,6 +387,7 @@ class InProcessCoordinator:
         for t in stale:
             del self._leased[t]
             self._todo.append(t)
+            self._record_lease(t, "", "")
 
     def _renew_leases(self, worker: str) -> None:
         """A live worker keeps its leases (etcd-keepalive semantics): renewal
@@ -183,6 +412,7 @@ class InProcessCoordinator:
         with self._lock:
             self._tick()
             self._drop_member(worker)
+            self._commit()
             return {"ok": True, "epoch": self._epoch}
 
     def members(self) -> List[str]:
@@ -203,11 +433,15 @@ class InProcessCoordinator:
         with self._lock:
             self._tick()
             added = 0
+            fresh: List[str] = []
             for t in tasks:
                 if t in self._done or t in self._leased or t in self._todo:
                     continue
                 self._todo.append(t)
+                fresh.append(t)
                 added += 1
+            self._record_todo(fresh)
+            self._commit()
             return added
 
     def acquire(self, worker: str, req_id: Optional[str] = None) -> Dict:
@@ -233,6 +467,11 @@ class InProcessCoordinator:
             }
             if req_id:
                 self._acquire_cache[worker] = (req_id, task)
+            # The req_id rides the lease record (the EDL010 durability fix:
+            # an unjournaled dedup cache would hand a retried acquire a
+            # SECOND task after restart — an exactly-once violation).
+            self._record_lease(task, worker, req_id or "")
+            self._commit()
             return {"ok": True, "task": task, "lease_sec": self.task_lease_sec}
 
     def acquire_task(self, worker: str) -> Optional[str]:
@@ -252,6 +491,8 @@ class InProcessCoordinator:
                 if task in self._todo:
                     self._todo.remove(task)
                     self._done.add(task)
+                    self._record_done(task)
+                    self._commit()
                     return {"ok": True, "requeued": True,
                             "done": len(self._done), "queued": len(self._todo)}
                 return {"ok": False, "error": "not leased"}
@@ -259,6 +500,8 @@ class InProcessCoordinator:
                 return {"ok": False, "error": "lease not owned"}
             del self._leased[task]
             self._done.add(task)
+            self._record_done(task)
+            self._commit()
             return {"ok": True, "done": len(self._done), "queued": len(self._todo)}
 
     def fail_task(self, worker: str, task: str) -> Dict:
@@ -270,6 +513,8 @@ class InProcessCoordinator:
                 return {"ok": False, "error": "lease not owned"}
             del self._leased[task]
             self._todo.append(task)
+            self._record_lease(task, "", "")
+            self._commit()
             return {"ok": True}
 
     def barrier(self, worker: str, name: str, count: int, timeout: float = 120.0) -> Dict:
@@ -339,13 +584,17 @@ class InProcessCoordinator:
         parked sync waiters resync so workers observe a rescale immediately."""
         with self._barrier_cv:
             self._epoch += 1
+            self._record_epoch()
             self._notify_watchers()
             self._release_sync()
+            self._commit()
             return {"ok": True, "epoch": self._epoch}
 
     def kv_put(self, key: str, value: str) -> None:
         with self._lock:
             self._kv[key] = value
+            self._record_kv(key)
+            self._commit()
 
     def kv_get(self, key: str) -> Optional[str]:
         with self._lock:
@@ -353,7 +602,10 @@ class InProcessCoordinator:
 
     def kv_del(self, key: str) -> None:
         with self._lock:
-            self._kv.pop(key, None)
+            if key in self._kv:  # native parity: a no-op del is not journaled
+                del self._kv[key]
+                self._record_kv_del(key)
+                self._commit()
 
     def kv_incr(self, key: str, delta: int = 1,
                 op_id: Optional[str] = None) -> int:
@@ -381,8 +633,14 @@ class InProcessCoordinator:
             except ValueError:
                 return {"ok": False, "error": "value not an integer"}
             self._kv[key] = str(cur)
+            self._record_kv(key)
             if marker:
                 self._kv[marker] = str(cur)
+                self._record_kv(marker)
+            # value + marker share one frame: both durable or neither —
+            # a partially-replayed frame here is exactly the torn-tail
+            # double-apply EDL010's torn schedule hunts.
+            self._commit()
             return {"ok": True, "value": cur}
 
     #: put_id dedup markers kept (FIFO) before the oldest is forgotten —
@@ -543,11 +801,16 @@ class InProcessCoordinator:
             if not key:
                 return {"ok": False, "error": "key required"}
             self._kv[key] = value
+            self._record_kv(key)
+            self._commit()
             return {"ok": True}
 
     def kv_del_reply(self, key: str) -> Dict:
         with self._lock:
-            self._kv.pop(key, None)
+            if key in self._kv:
+                del self._kv[key]
+                self._record_kv_del(key)
+                self._commit()
             return {"ok": True}
 
     def add_tasks_reply(self, tasks: List[str]) -> Dict:
@@ -569,14 +832,15 @@ class InProcessCoordinator:
                 "leased": len(self._leased),
                 "done": len(self._done),
                 # Wire-parity counters: ops/batch counts are real; the
-                # journal trio is structurally zero (no disk in-process) and
+                # journal trio is real when a state file is configured and
+                # structurally zero otherwise (no disk in-process), and
                 # "turns" mirrors ops — every op is its own event-loop turn.
                 "ops": self._ops_count,
                 "batch_frames": self._batch_frames,
                 "batch_subops": self._batch_subops,
-                "fsyncs": 0,
-                "snapshots": 0,
-                "journal_records": 0,
+                "fsyncs": self._fsyncs,
+                "snapshots": self._snapshots,
+                "journal_records": self._records_since,
                 "turns": self._ops_count,
                 "uptime_seconds": time.monotonic() - self._boot_monotonic,
                 # native-parity encoding: flat "worker=count" strings (the
@@ -904,6 +1168,20 @@ class InProcessClient:
         the coordinator's own; framing adds nothing in-process. Accepts the
         wire encoding too (JSON strings with an "op" key)."""
         self._c.note_batch(len(ops))
+        # One frame per batch (native parity: the whole batch is one
+        # event-loop turn): sub-op records accumulate and group-commit
+        # together when the frame closes.
+        with self._c._lock:
+            self._c._turn_depth += 1
+        try:
+            replies = self._call_batch_inner(ops, timeout)
+        finally:
+            with self._c._lock:
+                self._c._turn_depth -= 1
+                self._c._commit()
+        return replies
+
+    def _call_batch_inner(self, ops, timeout=None):
         replies = []
         for item in ops:
             if isinstance(item, str):

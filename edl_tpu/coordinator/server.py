@@ -90,6 +90,7 @@ class CoordinatorServer:
         shards: Optional[List[str]] = None,
         shard_index: int = -1,
         num_shards: int = 0,
+        extra_env: Optional[Dict[str, str]] = None,
     ):
         self.port = port or free_port()
         self.task_lease_sec = task_lease_sec
@@ -118,6 +119,11 @@ class CoordinatorServer:
         #: of the keyspace; membership lives on the root.
         self.shard_index = shard_index
         self.num_shards = num_shards
+        #: extra environment stamped into the child on every start() —
+        #: the EDL010 native-oracle lane injects its crash hooks
+        #: (EDL_COORD_CRASH_AFTER_APPENDS, ...) here, and clears them
+        #: before the post-crash restart. Mutable between restarts.
+        self.extra_env: Dict[str, str] = dict(extra_env or {})
         self._proc: Optional[subprocess.Popen] = None
         self._stderr_path: Optional[str] = None
         #: stderr of the last exited/stopped process (sanitizer reports live
@@ -159,6 +165,7 @@ class CoordinatorServer:
         env.setdefault("TSAN_OPTIONS", "exitcode=66")
         env.setdefault("ASAN_OPTIONS", "exitcode=66")
         env.setdefault("UBSAN_OPTIONS", "print_stacktrace=1")
+        env.update(self.extra_env)
         # stderr goes to a file, not DEVNULL: sanitizer reports (and crash
         # diagnostics) must survive the process; sanitizer_report() reads it.
         fd, self._stderr_path = tempfile.mkstemp(

@@ -328,6 +328,16 @@ class Coordinator {
       : task_lease_sec_(task_lease_sec), heartbeat_ttl_sec_(heartbeat_ttl_sec),
         state_file_(std::move(state_file)), run_id_(std::move(run_id)),
         auth_token_(std::move(auth_token)) {
+    // EDL010 crash-injection hooks (env-gated, test-only): the model
+    // checker's native-oracle lane arms these to realize a modeled crash
+    // point inside the real binary — die after the Nth append frame
+    // (optionally tearing the tail first), or inside the Nth snapshot
+    // write before its rename. Unset/zero = disabled.
+    const char* e;
+    if ((e = getenv("EDL_COORD_CRASH_AFTER_APPENDS"))) crash_after_appends_ = atoll(e);
+    if ((e = getenv("EDL_COORD_CRASH_TORN"))) crash_torn_ = atoll(e) != 0;
+    if ((e = getenv("EDL_COORD_CRASH_IN_SNAPSHOT"))) crash_in_snapshot_ = atoll(e);
+    if ((e = getenv("EDL_COORD_COMPACT_EVERY"))) compact_every_override_ = atoll(e);
     if (!state_file_.empty()) load_state();
   }
 
@@ -416,9 +426,13 @@ class Coordinator {
   // never hand a shard that is mid-training to a second worker (the
   // exactly-once half of the chaos criterion). Truly-dead holders still
   // requeue via normal TTL expiry after the restart.
-  void record_lease(const std::string& task, const std::string& worker) {
+  // The req_id rides the lease record (EDL010): the acquire dedup cache is
+  // durable state — an unjournaled cache would hand a retried acquire a
+  // SECOND task after a restart, an exactly-once violation across crash.
+  void record_lease(const std::string& task, const std::string& worker,
+                    const std::string& req_id = "") {
     record(JsonWriter().field("k", "lease").field("task", task)
-               .field("worker", worker).done());
+               .field("worker", worker).field("req_id", req_id).done());
   }
   void record_kv_del(const std::string& key) {
     record(JsonWriter().field("k", "kvdel").field("key", key).done());
@@ -603,6 +617,14 @@ class Coordinator {
   long long appended_records_ = 0; // deltas since the last snapshot
   long long journal_appends_ = 0;  // lifetime delta records (monotonic)
   bool need_snapshot_ = false;     // e.g. run-id mismatch discarded the file
+  // EDL010 crash-injection state (see the constructor's env hooks).
+  // Counts are 1-based: the Nth matching event dies with _exit(2).
+  long long crash_after_appends_ = 0;
+  bool crash_torn_ = false;
+  long long crash_in_snapshot_ = 0;
+  long long compact_every_override_ = 0;  // test threshold: records >= N
+  long long appends_done_ = 0;            // committed append frames
+  long long snapshot_attempts_ = 0;       // save_snapshot entries
   double next_scan_ = 0;           // earliest time tick() must rescan deadlines
   // Control-plane telemetry (op_status): bench_coord.py derives ops/sec,
   // batch amortization, and journal fsyncs-per-op from deltas of these.
@@ -625,6 +647,7 @@ class Coordinator {
 //   {"k":"kvdel","key":K}           (delta only)
 bool Coordinator::save_snapshot() {
   if (append_fp_) { fclose(append_fp_); append_fp_ = nullptr; }
+  snapshot_attempts_++;
   std::string tmp = state_file_ + ".tmp";
   FILE* f = fopen(tmp.c_str(), "w");
   if (!f) { perror("state-file open"); return false; }
@@ -636,18 +659,35 @@ bool Coordinator::save_snapshot() {
   // Live leases persist WITH their holder: a restarted coordinator grants
   // each lease a fresh TTL, so a worker that rode out the outage keeps its
   // shards (no double-assign) and a dead worker's shards requeue on expiry.
-  for (auto& [task, lease] : leased_)
+  // The holder's cached acquire req_id rides along (EDL010: dedup tables
+  // are durable state), so a retried acquire still answers from the cache
+  // after a restart instead of popping a second task.
+  for (auto& [task, lease] : leased_) {
+    std::string req;
+    auto cit = acquire_cache_.find(lease.worker);
+    if (cit != acquire_cache_.end() && cit->second.second == task)
+      req = cit->second.first;
     out += JsonWriter().field("k", "lease").field("task", task)
-               .field("worker", lease.worker).done();
+               .field("worker", lease.worker).field("req_id", req).done();
+  }
   std::vector<std::string> done(done_.begin(), done_.end());
   out += JsonWriter().field("k", "done").field("tasks", done).done();
   for (auto& [key, value] : kv_)
     out += JsonWriter().field("k", "kv").field("key", key).field("value", value).done();
+  // The snapshot is one committed frame: close it with the same marker the
+  // append path writes, so the tail-commit scan accepts a freshly-compacted
+  // file without a legacy-fallback special case.
+  out += JsonWriter().field("k", "c").done();
   bool ok = fwrite(out.data(), 1, out.size(), f) == out.size();
   ok = fflush(f) == 0 && ok;
   ok = fsync(fileno(f)) == 0 && ok;
   fclose(f);
   if (!ok) { fprintf(stderr, "state-file write failed\n"); return false; }
+  // Crash point: the tmp file is fully written but the rename never runs —
+  // recovery must replay the untouched journal and show NONE of the frame
+  // that triggered this compaction (it died with the snapshot).
+  if (crash_in_snapshot_ > 0 && snapshot_attempts_ >= crash_in_snapshot_)
+    _exit(2);
   if (rename(tmp.c_str(), state_file_.c_str()) != 0) {
     perror("state-file rename");
     return false;
@@ -671,6 +711,44 @@ void Coordinator::load_state() {
   size_t n;
   while ((n = fread(buf, 1, sizeof buf, f)) > 0) content.append(buf, n);
   fclose(f);
+  // Tail-commit scan (EDL010): frames are closed by {"k":"c"} marker
+  // lines; only the prefix up to the LAST marker is durable. Everything
+  // after it is a torn frame (power died mid-write) and is dropped WHOLE —
+  // all-or-nothing is the frame contract; replaying a frame's first
+  // records without its last (e.g. a kv_incr value without its op_id
+  // marker) silently double-applies on retry. The torn bytes are also
+  // truncated off disk so the next append cannot concatenate onto a
+  // half-written line. Files from the pre-marker format (no "c" records
+  // at all) are taken whole — legacy fallback.
+  {
+    size_t committed_end = 0;
+    bool has_marker = false;
+    size_t p = 0;
+    while (p < content.size()) {
+      size_t nl = content.find('\n', p);
+      if (nl == std::string::npos) nl = content.size();
+      std::string line = content.substr(p, nl - p);
+      size_t end = nl < content.size() ? nl + 1 : nl;
+      if (!line.empty()) {
+        JsonObject obj;
+        JsonParser parser(line);
+        if (parser.parse_object(&obj) && get_str(obj, "k") == "c") {
+          has_marker = true;
+          committed_end = end;
+        }
+      }
+      p = end;
+    }
+    if (has_marker && committed_end < content.size()) {
+      fprintf(stderr,
+              "edl-coordinator: state file %s has a torn tail frame "
+              "(%zu uncommitted byte(s)); truncating\n",
+              state_file_.c_str(), content.size() - committed_end);
+      if (truncate(state_file_.c_str(), (off_t)committed_end) != 0)
+        perror("state-file torn-tail truncate");
+      content.resize(committed_end);
+    }
+  }
   // Two-phase replay: deltas mean a task can appear in a "todo" line and a
   // later "done" line — collect everything first, then rebuild the queue
   // excluding completed work.
@@ -710,10 +788,17 @@ void Coordinator::load_state() {
     } else if (kind == "lease") {
       std::string t = get_str(obj, "task");
       if (!t.empty()) {
-        lease_of[t] = get_str(obj, "worker");
+        std::string w = get_str(obj, "worker");
+        std::string req = get_str(obj, "req_id");
+        lease_of[t] = w;
         // A lease implies the task exists even if its todo line predates
         // this file's snapshot horizon.
         if (todo_seen.insert(t).second) todo_order.push_back(t);
+        // Rebuild the acquire dedup cache (EDL010): the req_id journaled
+        // with the grant survives restart, so a client retrying a lost
+        // acquire reply still gets its ORIGINAL lease back, not a second
+        // task. Last record wins, matching the live cache's semantics.
+        if (!w.empty() && !req.empty()) acquire_cache_[w] = {req, t};
       }
     } else if (kind == "kv") {
       kv_[get_str(obj, "key")] = get_str(obj, "value");
@@ -734,6 +819,7 @@ void Coordinator::load_state() {
             state_file_.c_str(), file_run_id.c_str(), run_id_.c_str());
     done_.clear();
     kv_.clear();
+    acquire_cache_.clear();
     epoch_ = file_epoch + 1;
     need_snapshot_ = true;  // rewrite the file under our identity
     return;
@@ -782,7 +868,12 @@ bool Coordinator::maybe_save_state() {
   // full rewrite on EVERY dirty event-loop iteration.
   long long base = (long long)(todo_.size() + leased_.size() + done_.size() +
                                kv_.size()) + 1;
-  if (appended_records_ > 1024 && appended_records_ > 2 * base) {
+  bool want_compact = appended_records_ > 1024 && appended_records_ > 2 * base;
+  // Test override (EDL010): a fixed low threshold so crash-during-
+  // compaction schedules reach the snapshot path in a handful of ops.
+  if (compact_every_override_ > 0)
+    want_compact = appended_records_ >= compact_every_override_;
+  if (want_compact) {
     if (save_snapshot()) {
       pending_.clear();
       return true;
@@ -793,11 +884,16 @@ bool Coordinator::maybe_save_state() {
     append_fp_ = fopen(state_file_.c_str(), "a");
     if (!append_fp_) { perror("state-file append open"); return false; }  // retry
   }
+  // Close the frame with its commit marker: recovery replays a frame
+  // all-or-nothing — records after the last marker are a torn tail and
+  // are truncated away by load_state()'s tail-commit scan.
+  std::string frame = pending_;
+  frame += JsonWriter().field("k", "c").done();
   long long nrec = 0;
-  for (char c : pending_) nrec += (c == '\n');
+  for (char c : frame) nrec += (c == '\n');
   fseeko(append_fp_, 0, SEEK_END);
   off_t pre_append = ftello(append_fp_);  // rollback point for partial writes
-  bool ok = fwrite(pending_.data(), 1, pending_.size(), append_fp_) == pending_.size();
+  bool ok = fwrite(frame.data(), 1, frame.size(), append_fp_) == frame.size();
   ok = fflush(append_fp_) == 0 && ok;
   // Group commit: ONE fsync covers every mutation this event-loop turn
   // accumulated into pending_ — with N concurrent clients the per-op fsync
@@ -822,6 +918,28 @@ bool Coordinator::maybe_save_state() {
   journal_appends_ += nrec;
   fsyncs_++;
   pending_.clear();
+  appends_done_++;
+  if (crash_after_appends_ > 0 && appends_done_ >= crash_after_appends_) {
+    // Crash point (EDL010): the frame IS durable (fsync returned), the
+    // reply never flushes. Torn mode first rewinds the file to mid-frame —
+    // the commit marker and half of the final data record gone — the
+    // on-disk shape of power dying inside the write instead of after it.
+    if (crash_torn_) {
+      size_t marker_len = JsonWriter().field("k", "c").done().size();
+      size_t data_len = frame.size() - marker_len;
+      if (data_len > 0) {
+        size_t prev_nl = data_len >= 2 ? frame.rfind('\n', data_len - 2)
+                                       : std::string::npos;
+        size_t last_start = prev_nl == std::string::npos ? 0 : prev_nl + 1;
+        size_t cut = last_start + (data_len - last_start) / 2;
+        fclose(append_fp_);
+        append_fp_ = nullptr;
+        if (truncate(state_file_.c_str(), pre_append + (off_t)cut) != 0)
+          perror("state-file tear");
+      }
+    }
+    _exit(2);
+  }
   return true;
 }
 
@@ -1041,7 +1159,7 @@ std::string Coordinator::op_acquire_task(const JsonObject& req) {
   todo_set_.erase(task);
   leased_[task] = Lease{task, worker, now_sec() + task_lease_sec_};
   lease_index_add(worker, task);
-  record_lease(task, worker);
+  record_lease(task, worker, req_id);
   if (!req_id.empty()) acquire_cache_[worker] = {req_id, task};
   return JsonWriter().field("ok", true).field("task", task)
       .field("lease_sec", task_lease_sec_).done();

@@ -612,12 +612,12 @@ def test_cli_parse_error_exits_two(tmp_path, capsys):
     assert rc == 2
 
 
-def test_cli_list_rules_names_all_nine(capsys):
+def test_cli_list_rules_names_all_ten(capsys):
     rc = cli_main(["--list-rules"])
     out = capsys.readouterr().out
     assert rc == 0
     for rule in ("EDL001", "EDL002", "EDL003", "EDL004", "EDL005",
-                 "EDL006", "EDL007", "EDL008", "EDL009"):
+                 "EDL006", "EDL007", "EDL008", "EDL009", "EDL010"):
         assert rule in out
 
 
@@ -1417,6 +1417,91 @@ def test_edl009_reports_missing_state_effects_block(tmp_path):
     )
     (f,) = report.findings
     assert "state_effects" in f.message
+
+
+# -- EDL010: durability model check ---------------------------------------------
+
+
+def test_edl010_green_on_the_real_coordinator():
+    """The committed twin + schema pass the crash-recovery exploration:
+    all six durability schedules, zero findings."""
+    report = analyze(
+        [str(REPO_ROOT / "edl_tpu" / "coordinator" / "inprocess.py")],
+        root=str(REPO_ROOT),
+        rules=["EDL010"],
+    )
+    assert report.findings == []
+
+
+def test_edl010_skips_trees_without_the_twin_module(tmp_path):
+    report = check(tmp_path, "x = 1\n", ["EDL010"])
+    assert report.findings == []
+
+
+def test_edl010_reports_malformed_durability_tags(tmp_path):
+    """An untagged op and a tag naming an unknown journal record kind are
+    findings on the schema artifact, and block exploration (a spec the
+    model cannot read proves nothing)."""
+    target = tmp_path / "edl_tpu" / "coordinator"
+    target.mkdir(parents=True)
+    (target / "inprocess.py").write_text("x = 1\n")
+    (tmp_path / "protocol_schema.json").write_text(json.dumps({
+        "ops": {"ping": {}, "register": {}, "kv_put": {}},
+        "state_effects": {
+            "ping": {"durability": "none"},
+            "register": {},  # untagged
+            "kv_put": {"durability": "journal:blob"},  # unknown kind
+        },
+    }))
+    report = analyze(
+        [str(target / "inprocess.py")], root=str(tmp_path), rules=["EDL010"]
+    )
+    messages = sorted(f.message for f in report.findings)
+    assert len(messages) == 2
+    assert "kv_put" in messages[0] and "unknown record kind" in messages[0]
+    assert "register" in messages[1] and "missing" in messages[1]
+    assert all(f.path == "protocol_schema.json" for f in report.findings)
+
+
+def test_validate_durability_tag_vocabulary():
+    from edl_tpu.analysis.checkers.durability import validate_durability_tag
+
+    assert validate_durability_tag("none") is None
+    assert validate_durability_tag("volatile") is None
+    assert validate_durability_tag("composite") is None
+    assert validate_durability_tag("journal:kv") is None
+    assert validate_durability_tag("journal:meta,lease") is None
+    assert validate_durability_tag(None) is not None
+    assert validate_durability_tag("") is not None
+    assert validate_durability_tag("journal:") is not None
+    assert validate_durability_tag("journal:quantum") is not None
+    assert validate_durability_tag("durable-ish") is not None
+
+
+def test_write_protocol_preserves_durability_tags(tmp_path, monkeypatch,
+                                                  capsys):
+    """--write-protocol regenerates the extraction but must carry the
+    hand-authored state_effects block — including EDL010's durability
+    tags — through unchanged."""
+    native = tmp_path / "native" / "coordinator"
+    native.mkdir(parents=True)
+    (native / "coordinator.cc").write_text(textwrap.dedent(_TOY_CC))
+    effects = {
+        "ping": {"durability": "none"},
+        "register": {"epoch": "bump", "durability": "journal:meta,lease"},
+        "kv_put": {"durability": "journal:kv"},
+    }
+    (tmp_path / "protocol_schema.json").write_text(json.dumps({
+        "ops": {"stale": {}},
+        "state_effects": effects,
+    }))
+    monkeypatch.chdir(tmp_path)
+    rc = cli_main(["--write-protocol"])
+    capsys.readouterr()
+    assert rc == 0
+    written = json.loads((tmp_path / "protocol_schema.json").read_text())
+    assert written["state_effects"] == effects
+    assert "stale" not in written["ops"]  # extraction replaced the op set
 
 
 # -- SARIF output ---------------------------------------------------------------

@@ -1,17 +1,27 @@
-"""Tests for the EDL009 protocol model checker (edl_tpu.analysis.modelcheck).
+"""Tests for the EDL009/EDL010 protocol model checker
+(edl_tpu.analysis.modelcheck).
 
 Layers:
 
 - the acceptance configuration: exhaustive DFS over the default 2-worker
   faulty schedule (crash+restart, duplicate acquire, duplicate kv_incr, a
   batch frame) is green, every trace replayed against InProcessCoordinator;
+- the EDL010 durability lanes: crash points enumerated between persistence
+  effects (clean / pre-ack / torn tail / during compaction) with recovery
+  replay, checked against the file-backed persistence twin — and the
+  sleep-set POR's soundness (reduced exploration reaches the same
+  violation set as unreduced);
 - teeth: a deliberately mutated twin (request dedup disabled via the
   test-only ``_test_disable_dedup`` flag) is caught, through both the
-  model/oracle divergence and the exactly-once monitor;
+  model/oracle divergence and the exactly-once monitor; a twin that skips
+  torn-tail detection (``skip_tail_scan``) replays partial frames and is
+  caught by the acked-durability invariant;
 - the fuzz mode's soundness contract: any violation the seeded random walk
   reports is also reported by the exhaustive run at the same depth;
 - parked-op handling: barrier/sync release and bounded-progress deadlock
-  detection.
+  detection;
+- the --dump-trace / --replay-trace round trip on a violating
+  interleaving.
 """
 
 import json
@@ -22,13 +32,21 @@ import pytest
 
 from edl_tpu.analysis.modelcheck import (
     LAST_TASK,
+    DurableTwinOracle,
     ModelCheckError,
     ProtocolModel,
+    Schedule,
     ScriptOp,
     default_scripts,
+    dump_trace_spec,
+    durability_base_scripts,
+    durability_dedup_scripts,
+    durability_schedules,
+    durability_torn_scripts,
     explore,
     load_state_effects,
     main as modelcheck_main,
+    replay_trace_spec,
     run_default,
 )
 
@@ -67,8 +85,10 @@ def test_default_exhaustive_is_green_and_fully_replayed():
     assert result.violations == []
     # C(13, 6) interleavings of the default scripts + C(8, 4) of the
     # checkpoint-plane schedule + C(11, 3) watch/notify + C(10, 4)
-    # redirect-during-watch (run_default merges all four)
-    assert result.traces == 1716 + 70 + 165 + 210
+    # redirect-during-watch + the EDL010 durability rows (POR-reduced
+    # except durability-compact, which runs unreduced at C(13, 6)):
+    # 118 + 50 + 28 + 1716 + 21 + 196 = 2129. run_default merges all ten.
+    assert result.traces == 1716 + 70 + 165 + 210 + 2129
     assert result.replays == result.traces
     assert result.ok()
     assert elapsed < 90.0
@@ -88,7 +108,26 @@ def test_state_effects_cover_the_full_op_set():
     effects, ops, err = load_state_effects(REPO_ROOT)
     assert err is None
     assert set(effects) == ops
-    assert len(ops) >= 21
+    assert len(ops) >= 22
+
+
+def test_every_op_carries_a_valid_durability_tag():
+    """The EDL010 ratchet, pinned to the repo schema: every op in the
+    dispatch table declares what it persists, with a well-formed tag —
+    and the journaled core is tagged as such."""
+    from edl_tpu.analysis.checkers.durability import validate_durability_tag
+
+    effects, ops, err = load_state_effects(REPO_ROOT)
+    assert err is None
+    assert len(ops) >= 22
+    for op in sorted(ops):
+        tag = (effects.get(op) or {}).get("durability")
+        assert validate_durability_tag(tag) is None, (
+            f"op {op!r}: bad durability tag {tag!r}")
+    assert effects["acquire_task"]["durability"] == "journal:lease"
+    assert effects["kv_incr"]["durability"] == "journal:kv"
+    assert effects["register"]["durability"] == "journal:meta,lease"
+    assert effects["shard_put"]["durability"] == "volatile"  # unjournaled
 
 
 # -- teeth: the mutated twin ----------------------------------------------------
@@ -117,9 +156,9 @@ def test_mutant_violation_messages_name_the_replayed_request():
 def test_fuzz_on_green_twin_stays_green():
     result = run_default(fuzz_samples=40, fuzz_seed=7)
     assert result.violations == []
-    # 40 samples per schedule (default, ckpt-plane, watch, redirect),
-    # identical ones dedup
-    assert 0 < result.traces <= 160
+    # 40 samples per schedule (4 legacy + 6 durability rows), identical
+    # ones dedup
+    assert 0 < result.traces <= 400
     assert result.replays == result.traces
 
 
@@ -142,6 +181,167 @@ def test_fuzz_is_deterministic_per_seed():
     b = run_default(fuzz_samples=25, fuzz_seed=11)
     assert a.traces == b.traces
     assert a.violation_keys() == b.violation_keys()
+
+
+# -- EDL010: crash-point durability schedules -----------------------------------
+
+
+def test_durability_schedules_green_with_pinned_trace_counts():
+    """Each durability lane explored in isolation, every trace replayed
+    against the file-backed persistence twin — per-schedule trace counts
+    pinned so a schedule silently shrinking (lost crash points) fails."""
+    result = run_default(schedules=[s.name for s in durability_schedules()])
+    assert result.violations == []
+    assert result.replays == result.traces
+    counts = {name: traces for name, traces, _s in result.timings}
+    assert counts == {
+        "durability-base": 118,           # clean crash, POR-reduced
+        "durability-dedup": 50,           # pre_ack + straddling dups
+        "durability-torn": 28,            # torn tail, all-or-nothing
+        "durability-compact": 1716,       # snapshot path, unreduced C(13,6)
+        "durability-crash-compact": 21,   # crash inside snapshot write
+        "durability-shard": 196,          # unjournaled shard-store honesty
+    }
+    assert sum(counts.values()) == 2129
+
+
+def test_schedule_name_filter_rejects_unknown_names():
+    with pytest.raises(ModelCheckError, match="unknown schedule"):
+        run_default(schedules=["durability-base", "no-such-lane"])
+
+
+def test_nonclean_crash_with_compaction_is_a_spec_error():
+    """torn / pre_ack / during_compaction crash points assume the inflight
+    frame is the journal tail; under an active compaction threshold the
+    tail may be a snapshot instead, so the combination is rejected up
+    front rather than modeled wrong."""
+    mk2 = ScriptOp.make
+    scripts = {"w0": [mk2("register", worker="w0"),
+                      mk2("crash", mode="torn", worker="w0",
+                          inflight=[{"op": "kv_put", "key": "k",
+                                     "value": "v"}])]}
+    with pytest.raises(ModelCheckError):
+        explore(scripts, _effects(),
+                coordinator_factory=lambda: DurableTwinOracle(compact_every=4),
+                durable=True, compact_every=4)
+
+
+def test_por_soundness_reduced_equals_unreduced_on_green_twin():
+    """Sleep-set POR prunes interleavings that only reorder independent
+    ops; on the green twin both runs must be empty AND the reduction must
+    actually reduce."""
+    full = explore(durability_base_scripts(), _effects(),
+                   coordinator_factory=lambda: DurableTwinOracle(),
+                   durable=True, por=False)
+    reduced = explore(durability_base_scripts(), _effects(),
+                      coordinator_factory=lambda: DurableTwinOracle(),
+                      durable=True, por=True)
+    assert full.violations == [] and reduced.violations == []
+    assert reduced.traces == 118
+    assert reduced.traces < full.traces
+
+
+def test_por_soundness_reduced_catches_what_unreduced_catches():
+    """On the dedup-disabled mutant the reduced exploration must reach
+    the same violation KINDS as the unreduced one, and every reduced
+    violation key must exist in the unreduced set (POR may drop redundant
+    witnesses, never bug classes)."""
+    mutant = lambda: DurableTwinOracle(disable_dedup=True)  # noqa: E731
+    full = explore(durability_dedup_scripts(), _effects(),
+                   coordinator_factory=mutant, durable=True, por=False,
+                   max_violations=10 ** 6)
+    reduced = explore(durability_dedup_scripts(), _effects(),
+                      coordinator_factory=mutant, durable=True, por=True,
+                      max_violations=10 ** 6)
+    assert reduced.violations, "POR must not hide the planted bug"
+    assert reduced.violation_keys() <= full.violation_keys()
+    assert ({v.kind for v in reduced.violations}
+            == {v.kind for v in full.violations})
+
+
+def test_torn_tail_mutant_skip_tail_scan_is_caught():
+    """The mutant-teeth scenario: a twin whose recovery skips torn-tail
+    frame detection replays the half-written kv_incr value record without
+    its op_id marker — the post-crash retry double-applies, caught as an
+    acked-durability divergence (and/or exactly-once)."""
+    mutant = lambda: DurableTwinOracle(skip_tail_scan=True)  # noqa: E731
+    result = explore(durability_torn_scripts(), _effects(),
+                     coordinator_factory=mutant, durable=True, por=True,
+                     max_violations=100)
+    assert result.violations, "torn-tail-blind twin must not pass"
+    kinds = {v.kind for v in result.violations}
+    assert kinds & {"acked-durability", "exactly-once"}
+
+
+def test_dedup_mutant_is_caught_across_the_crash():
+    """Replay dedup disabled: the duplicate acquire AFTER recovery hands
+    out a second grant for the same req_id — exactly-once must hold
+    across the crash, not merely within one incarnation."""
+    mutant = lambda: DurableTwinOracle(disable_dedup=True)  # noqa: E731
+    result = explore(durability_dedup_scripts(), _effects(),
+                     coordinator_factory=mutant, durable=True, por=True,
+                     max_violations=100)
+    assert result.violations
+    assert {v.kind for v in result.violations} & {
+        "acked-durability", "exactly-once", "oracle-divergence"}
+
+
+def test_fuzz_with_durability_schedules_is_deterministic():
+    a = run_default(schedules=["durability-base", "durability-torn"],
+                    fuzz_samples=20, fuzz_seed=13)
+    b = run_default(schedules=["durability-base", "durability-torn"],
+                    fuzz_samples=20, fuzz_seed=13)
+    assert a.violations == [] and b.violations == []
+    assert a.traces == b.traces > 0
+    assert ([(n, tr) for n, tr, _s in a.timings]
+            == [(n, tr) for n, tr, _s in b.timings])
+    assert a.violation_keys() == b.violation_keys()
+
+
+# -- trace spec round trip (--dump-trace / --replay-trace) ----------------------
+
+
+def test_dump_and_replay_trace_spec_roundtrip():
+    """A violating interleaving dumped as a JSON spec re-executes in
+    isolation — exact step order, no exploration — and reproduces the
+    violation on the same mutant."""
+    mutant = lambda: DurableTwinOracle(skip_tail_scan=True)  # noqa: E731
+    sched = Schedule("durability-torn", durability_torn_scripts(), mutant,
+                     durable=True, por=True)
+    result = explore(sched.scripts, _effects(), coordinator_factory=mutant,
+                     durable=True, por=True, max_violations=10,
+                     name="durability-torn")
+    assert result.violations
+    spec = dump_trace_spec(result.violations[0], schedules=[sched])
+    spec = json.loads(json.dumps(spec))  # must survive JSON round trip
+    assert spec["schedule"] == "durability-torn"
+    assert spec["durable"] is True
+    assert spec["order"], "dumped spec must carry the worker step order"
+    repro = replay_trace_spec(spec, _effects(), coordinator_factory=mutant)
+    assert repro, "dumped interleaving must reproduce on the mutant"
+    assert {v.kind for v in repro} & {"acked-durability", "exactly-once"}
+
+
+def test_replayed_spec_is_green_on_the_fixed_twin():
+    """The same dumped interleaving replayed against the HEALTHY twin
+    (the spec's default factory) passes — the bug is in the mutant, not
+    the schedule."""
+    mutant = lambda: DurableTwinOracle(skip_tail_scan=True)  # noqa: E731
+    sched = Schedule("durability-torn", durability_torn_scripts(), mutant,
+                     durable=True, por=True)
+    result = explore(sched.scripts, _effects(), coordinator_factory=mutant,
+                     durable=True, por=True, max_violations=10,
+                     name="durability-torn")
+    spec = dump_trace_spec(result.violations[0], schedules=[sched])
+    assert replay_trace_spec(spec, _effects()) == []
+
+
+def test_cli_schedules_filter_and_timings(capsys):
+    rc = modelcheck_main(["--schedules", "durability-torn", "--timings"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "28 trace(s)" in out and "0 violation(s)" in out
+    assert "durability-torn:" in out
 
 
 # -- parked ops: barrier / sync -------------------------------------------------
@@ -232,7 +432,7 @@ def test_cli_exhaustive_exits_zero(capsys):
     rc = modelcheck_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "2161 trace(s)" in out and "0 violation(s)" in out
+    assert "4290 trace(s)" in out and "0 violation(s)" in out
 
 
 def test_cli_json_fuzz(capsys):
@@ -241,3 +441,23 @@ def test_cli_json_fuzz(capsys):
     assert rc == 0
     assert payload["violations"] == []
     assert payload["replays"] == payload["traces"] > 0
+
+
+# -- native crash-injected oracle (make modelcheck-native's lane) ---------------
+
+
+@pytest.mark.sanitizer
+def test_native_oracle_replays_torn_tail_lane():
+    """One full durability lane against the REAL binary: each trace boots
+    an edl-coordinator armed to _exit(2) at the modeled crash point (torn
+    mode rewinds the journal tail first), then restarts it and checks
+    recovery against the model. Small lane (28 traces) so the per-trace
+    server boots stay inside the tier-1 budget."""
+    from tests.test_coordinator import has_toolchain
+
+    if not has_toolchain():
+        pytest.skip("native toolchain unavailable")
+    result = run_default(schedules=["durability-torn"], native=True)
+    assert result.violations == []
+    assert result.traces == 28
+    assert result.replays == 28
