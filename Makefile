@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
+ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane edl_tpu/parallel/planner.py edl_tpu/runtime/compile_cache.py bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke verify bench-pipeline bench-coord bench-collective bench-serve
+.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke bench-replan-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -112,12 +112,22 @@ tsan-smoke:
 bench-coord-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_coord.py --smoke
 
+## Replanner deploy gate: the live 8->6->8 rescale-with-layout-change arm
+## ({dcn:2,data:4} -> {data:6} -> back through join/leave/re-join) plus the
+## modeled sweep (planner must STRICTLY beat data-only resize at every
+## point). Asserts the return leg is served by the persistent AOT compile
+## cache (warm_compile ~ 0, compile_cache_hits_total >= 1) and every leg's
+## recovery is phase-attributed; merges replan_arm/replan_sweep into
+## BENCH_RESCALE.json + RESCALE_TIMELINE.json.
+bench-replan-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_rescale.py --replan
+
 ## Everything a PR must pass: static analysis (EDL001-EDL010 vs baseline +
 ## protocol_schema.json ratchet), tier-1 tests, protocol + durability model
 ## checks (in-process AND crash-armed native oracle), serving smoke, TSan
-## lane, bench-harness smoke. Tier-2 (slow, run before cutting a release):
-## `make chaos` / `make chaos-composed` — soaks + composed cross-axis run.
-verify: analyze test modelcheck modelcheck-native serve-smoke ckpt-plane-smoke tsan-smoke bench-coord-smoke
+## lane, bench-harness smokes (coordinator + replanner). Tier-2 (slow, run
+## before cutting a release): `make chaos` / `make chaos-composed`.
+verify: analyze test modelcheck modelcheck-native serve-smoke ckpt-plane-smoke tsan-smoke bench-coord-smoke bench-replan-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -25,12 +25,27 @@ collector loop example/fit_a_line/collector.py:215-226):
   elastic run itself trains with ``peer_replicas=1``, so the rescale's
   restore phase in RESCALE_TIMELINE.json carries ``source``/
   ``bytes_from_peers`` attribution.
+- ``replan_arm``: the live layout-change rescale — a worker wired with the
+  hybrid-parallel planner (``parallel.planner.plan_layout``) and the
+  persistent AOT compile cache walks ``{dcn:2,data:4}`` (8 chips, two
+  slices) -> ``{data:6}`` (6 chips, slice lost) -> back, through the real
+  join / graceful-leave / re-join control path. Each leg's recovery is
+  phase-attributed (drain / replan / reshard / warm_compile / restore /
+  first_step) and the RETURN leg must be served by the compile cache:
+  ``compile_cache == "hit"`` with warm_compile ~ 0 — revisiting a layout
+  costs zero compiles.
+- ``replan_sweep``: the modeled oracle — at every sweep point (chip count x
+  fabric shape) the planner's chosen layout's modeled step time must
+  STRICTLY beat the naive data-only resize scored under the same model.
 
 Run on the CPU simulation mesh by default (8 virtual devices; CI-stable);
 the same script runs unmodified on real chips. Writes BENCH_RESCALE.json
 plus RESCALE_TIMELINE.json — the stitched worker+controller span breakdown
-of the rescale (drain -> checkpoint -> warm_compile/restore -> first_step
-under one shared trace id; see doc/observability.md) — and prints both.
+of the rescale (drain -> checkpoint -> replan -> warm_compile/restore ->
+reshard -> first_step under one shared trace id; see doc/observability.md)
+— and prints both. ``--replan`` runs only the replan arm + sweep (the
+``make bench-replan-smoke`` gate) and merges its sections into existing
+artifacts.
 """
 
 from __future__ import annotations
@@ -83,6 +98,323 @@ class PhaseProfiler:
 
     def summary(self):
         return {"phases": float(len(self.phases))}
+
+
+#: the modeled replan sweep: (chips, fabric slices). Collective-bound
+#: profile (heavy params, light per-sample compute) — the regime where the
+#: layout choice dominates and the planner must strictly beat the naive
+#: data-only resize at EVERY point: multi-slice points win on hierarchy
+#: (a flat ring spilling past one slice is priced entirely at DCN speed),
+#: single-slice points win on pipeline hybrids (less ZeRO traffic per ring).
+REPLAN_SWEEP = [
+    (4, (4,)),
+    (6, (6,)),
+    (8, (4, 4)),
+    (12, (4, 4, 4)),
+    (16, (8, 8)),
+    (24, (8, 8, 8)),
+    (32, (16, 16)),
+]
+
+
+def _sweep_profile():
+    from edl_tpu.parallel import ModelProfile
+
+    return ModelProfile(
+        param_bytes=400e6, replicated_bytes=20e6, n_layers=24,
+        flops_per_sample=2e7, activation_bytes_per_microbatch=8e6)
+
+
+def run_replan_sweep() -> dict:
+    """Score every sweep point: planner argmin vs data-only baseline.
+    Asserts the strict win — this is the acceptance oracle, committed."""
+    from edl_tpu.parallel import Topology, plan_layout
+    from edl_tpu.parallel.planner import data_only_step_seconds
+
+    profile = _sweep_profile()
+    batch = 1536  # divides every dp x microbatch grid in the sweep
+    points = []
+    for chips, slices in REPLAN_SWEEP:
+        topo = Topology(slices=slices)
+        plan = plan_layout(chips, topo, profile, batch)
+        base = data_only_step_seconds(chips, topo, profile, batch)
+        win = plan.step_seconds < base
+        points.append({
+            "chips": chips,
+            "slices": list(slices),
+            "planned_layout": plan.describe(),
+            "planned_step_ms": round(plan.step_seconds * 1e3, 4),
+            "data_only_step_ms": round(base * 1e3, 4),
+            "speedup": round(base / plan.step_seconds, 3),
+            "strict_win": win,
+        })
+        assert win, (
+            f"planner failed to strictly beat data-only at {chips} chips "
+            f"on {slices}: {plan.describe()} {plan.step_seconds} vs {base}")
+    return {
+        "global_batch": batch,
+        "points": points,
+        "pass_planner_beats_data_only_everywhere": all(
+            p["strict_win"] for p in points),
+    }
+
+
+def run_replan_arm(devs) -> tuple:
+    """The live 8->6->8 rescale-with-layout-change arm.
+
+    Worlds map to chip counts (world 2 -> 8 chips over two virtual slices,
+    world 1 -> 6 chips of one slice), and the layout planner re-plans per
+    leg: cold start lands on ``{data:6}``, the join adopts hierarchical
+    ``{dcn:2,data:4}`` (compile-cache miss, stored), the graceful leave
+    falls back to ``{data:6}`` (miss, stored), and the re-join RETURNS to
+    ``{dcn:2,data:4}`` — which the persistent AOT cache must now serve
+    (``compile_cache == "hit"``, warm_compile ~ 0). Returns
+    ``(arm_result_dict, timeline_section_dict)``.
+    """
+    import tempfile
+
+    import numpy as np  # noqa: F401  (parity with main's imports)
+
+    from edl_tpu.controller.actuation import CoordinatorActuator
+    from edl_tpu.coordinator import CoordinatorServer
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.obs.tracing import RESCALE_PHASES, Tracer, rescale_timeline
+    from edl_tpu.parallel import ModelProfile, Topology, plan_layout
+    from edl_tpu.runtime import (
+        ElasticConfig, ElasticWorker, SyntheticShardSource, TrainerConfig,
+        shard_names,
+    )
+
+    model = fit_a_line.MODEL
+    tag = "rp"
+    # 240 divides both legs' dp grids (8 = dcn2 x data4, and data6).
+    batch_size = int(os.environ.get("EDL_REPLAN_BATCH", "240"))
+    n_shards = int(os.environ.get("EDL_REPLAN_SHARDS", "30"))
+    batches_per_shard = int(os.environ.get("EDL_REPLAN_BPS", "24"))
+    profile = ModelProfile(param_bytes=400e6, flops_per_sample=2e7)
+
+    def layout_planner(n_chips, devices):
+        # The fabric the planner sees tracks the failure mode: 8 chips are
+        # two DCN-connected 4-chip slices; losing one leaves 6 chips in a
+        # single ICI domain. schedules=() — fit_a_line has no stacked-layer
+        # pipeline structure, so the search is dp-shape-only here.
+        topo = (Topology(slices=(4, 4)) if n_chips == 8
+                else Topology(slices=(n_chips,)))
+        return plan_layout(n_chips, topo, profile, batch_size, schedules=())
+
+    workdir = tempfile.mkdtemp(prefix="edl-replan-")
+    trace = Tracer(component="bench")
+    with CoordinatorServer(task_lease_sec=120.0,
+                           heartbeat_ttl_sec=120.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks(shard_names(tag, n_shards))
+        worker = ElasticWorker(
+            model,
+            server.client("trainer-0"),
+            SyntheticShardSource(model, batch_size=batch_size,
+                                 batches_per_shard=batches_per_shard),
+            ElasticConfig(
+                checkpoint_dir=os.path.join(workdir, "ck"),
+                checkpoint_interval=50, heartbeat_interval=0.05,
+                rescale_barrier_timeout=30.0,
+                trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+                peer_replicas=1,
+                compile_cache_dir=os.path.join(workdir, "aot"),
+            ),
+            device_planner=lambda w: devs[:8] if w >= 2 else devs[:6],
+            tracer=trace,
+            layout_planner=layout_planner,
+        )
+        stop = threading.Event()
+        follower_stops = []
+
+        def follow(joiner, stop_evt):
+            """A joiner's side of the rendezvous protocol: sync the bumped
+            epoch, then heartbeat-follow until told to stop (same loop as
+            the elastic arm's control plane)."""
+            info = joiner.register()
+            epoch = info["epoch"]
+            while not stop_evt.is_set():
+                reply = joiner.sync(epoch, timeout=5.0)
+                if reply.get("ok"):
+                    break
+                epoch = reply.get("epoch", epoch)
+            while not stop_evt.is_set():
+                hb = joiner.heartbeat()
+                if hb.get("ok") and hb["epoch"] != epoch:
+                    epoch = hb["epoch"]
+                    joiner.sync(epoch, timeout=5.0)
+                time.sleep(0.1)
+
+        def wait_for(cond, what, timeout=180.0):
+            t0 = time.time()
+            while not cond():
+                if stop.is_set():
+                    return False
+                if time.time() - t0 > timeout:
+                    raise RuntimeError(f"replan arm stuck waiting for {what}")
+                time.sleep(0.02)
+            return True
+
+        def control_plane():
+            actuator = CoordinatorActuator()
+            actuator.set_endpoint(tag, "127.0.0.1", server.port)
+            # leg 1 (cold, 6 chips, {data:6}) is underway; join -> 8 chips
+            if not wait_for(lambda: worker.steps_done >= 10, "first steps"):
+                return
+            actuator.publish_expected_world(tag, 2)
+            j1 = server.client("trainer-1")
+            j1_stop = threading.Event()
+            follower_stops.append(j1_stop)
+            t1 = threading.Thread(target=follow, args=(j1, j1_stop),
+                                  daemon=True)
+            t1.start()
+            if not wait_for(lambda: len(worker.rescales) >= 1,
+                            "rescale to 8 chips"):
+                return
+            base = worker.steps_done
+            if not wait_for(lambda: worker.steps_done >= base + 15,
+                            "steps on {dcn:2,data:4}"):
+                return
+            # graceful leave -> 6 chips, flat {data:6}
+            actuator.publish_expected_world(tag, 1)
+            j1_stop.set()
+            t1.join(timeout=10)
+            j1.leave()
+            if not wait_for(lambda: len(worker.rescales) >= 2,
+                            "rescale back to 6 chips"):
+                return
+            base = worker.steps_done
+            if not wait_for(lambda: worker.steps_done >= base + 15,
+                            "steps on {data:6}"):
+                return
+            # re-join -> RETURN to {dcn:2,data:4}: the cache-hit leg
+            actuator.publish_expected_world(tag, 2)
+            j2 = server.client("trainer-2")
+            j2_stop = threading.Event()
+            follower_stops.append(j2_stop)
+            threading.Thread(target=follow, args=(j2, j2_stop),
+                             daemon=True).start()
+
+        t = threading.Thread(target=control_plane, daemon=True)
+        t.start()
+        try:
+            metrics = worker.run()
+        finally:
+            stop.set()
+            for evt in follower_stops:
+                evt.set()
+            t.join(timeout=15)
+
+    assert len(worker.rescales) >= 3, (
+        f"replan arm needs 3 rescales (join/leave/re-join), got "
+        f"{len(worker.rescales)}: {worker.rescales}")
+    legs = worker.rescales[-3:]
+    assert legs[0].layout == {"dcn": 2, "data": 4}, legs[0]
+    assert legs[1].layout == {"data": 6}, legs[1]
+    assert legs[2].layout == {"dcn": 2, "data": 4}, legs[2]
+    # THE acceptance bit: the second visit to {dcn:2,data:4} is served by
+    # the persistent AOT cache — zero compiles on the return leg.
+    assert legs[2].compile_cache == "hit", (
+        f"return leg not served from compile cache: {legs[2]}")
+    cache = worker.compile_cache
+    hits = cache.hits.value(tier="memory") + cache.hits.value(tier="disk")
+    assert hits >= 1, "compile cache reported a hit leg but zero hit counts"
+
+    timeline = rescale_timeline(trace.spans)
+    complete = {tid: tl for tid, tl in timeline.items()
+                if all(p in tl["phases"] for p in RESCALE_PHASES)}
+    assert len(complete) >= 3, (
+        f"expected 3 fully-attributed rescale traces, got "
+        f"{ {tid: sorted(tl['phases']) for tid, tl in timeline.items()} }")
+
+    def leg_doc(tid):
+        tl = complete[tid]
+        return {
+            "trace_id": tid,
+            "wall_seconds": round(tl["wall_seconds"], 6),
+            "phases": {
+                name: {
+                    "seconds": round(ph["seconds"], 6),
+                    "component": ph["component"],
+                    "attrs": ph.get("attrs", {}),
+                }
+                for name, ph in tl["phases"].items()
+            },
+        }
+
+    leg_ids = sorted(complete)[-3:]
+    arm = {
+        "rescale": "{dcn:2,data:4} -> {data:6} -> {dcn:2,data:4}",
+        "batch_size": batch_size,
+        "elastic_steps": metrics["steps"],
+        "legs": [
+            {
+                "from_world": r.from_world,
+                "to_world": r.to_world,
+                "layout": r.layout,
+                "recovery_seconds": round(r.recovery_seconds, 3),
+                "warm_compile_seconds": round(r.compile_seconds, 3),
+                "compile_cache": r.compile_cache,
+            }
+            for r in legs
+        ],
+        "compile_cache_hits_total": hits,
+        "compile_cache_entries_on_disk": cache.entries(),
+        "return_leg_warm_compile_seconds": round(legs[2].compile_seconds, 4),
+        "pass_return_leg_cached": legs[2].compile_cache == "hit",
+        "pass_all_phases_attributed": True,  # asserted above
+    }
+    tl_section = {
+        "rescale": arm["rescale"],
+        "legs": [leg_doc(tid) for tid in leg_ids],
+    }
+    return arm, tl_section
+
+
+def _merge_into_json(path: str, updates: dict) -> dict:
+    """Merge ``updates`` into an existing JSON artifact (the --replan smoke
+    must not clobber the full bench's sections)."""
+    doc = {}
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+    doc.update(updates)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
+def replan_main() -> None:
+    """`make bench-replan-smoke`: only the replan arm + modeled sweep,
+    merged into the committed artifacts."""
+    from bench import probe_devices
+
+    on_cpu_sim = os.environ.get("EDL_RESCALE_PLATFORM", "cpu") == "cpu"
+    devs, reason = probe_devices(
+        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
+        allow_cpu=on_cpu_sim,
+    )
+    if devs is None:
+        print(json.dumps({"error": reason}))
+        raise SystemExit(1)
+    if len(devs) < 8:
+        print(json.dumps({"error": f"replan arm needs 8 devices, have "
+                                   f"{len(devs)}"}))
+        raise SystemExit(1)
+    sweep = run_replan_sweep()
+    arm, tl_section = run_replan_arm(devs)
+    here = os.path.dirname(os.path.abspath(__file__))
+    result = _merge_into_json(
+        os.path.join(here, "BENCH_RESCALE.json"),
+        {"replan_arm": arm, "replan_sweep": sweep})
+    _merge_into_json(os.path.join(here, "RESCALE_TIMELINE.json"),
+                     {"replan_arm": tl_section})
+    print(json.dumps({"replan_arm": result["replan_arm"],
+                      "replan_sweep": result["replan_sweep"]}))
 
 
 def main() -> None:
@@ -278,6 +610,10 @@ def main() -> None:
     jax.block_until_ready(jax.tree_util.tree_leaves(peer_state))
     peer_arm_seconds = time.perf_counter() - t0
 
+    # -- layout-change arm + modeled sweep (the replanner's acceptance) --------
+    replan_sweep = run_replan_sweep()
+    replan_arm, replan_tl = run_replan_arm(devs)
+
     result = {
         "max_recovery_seconds": round(max_recovery, 3),
         "retention_vs_static": round(retention, 4),
@@ -294,6 +630,8 @@ def main() -> None:
             "peer_bytes": int(pinfo["bytes"]),
             "pass_peer_faster": peer_arm_seconds < blob_arm_seconds,
         },
+        "replan_arm": replan_arm,
+        "replan_sweep": replan_sweep,
         "details": {
             "devices": full,
             "rescale": f"{half}->{full} devices (world 1->2)",
@@ -352,6 +690,7 @@ def main() -> None:
             "phase seconds may sum past wall_seconds: warm_compile runs "
             "concurrent with restore by design (see doc/observability.md)"
         ),
+        "replan_arm": replan_tl,
     }
     tl_out = os.path.join(here, "RESCALE_TIMELINE.json")
     with open(tl_out, "w") as f:
@@ -360,4 +699,9 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+
+    if "--replan" in sys.argv:
+        replan_main()
+    else:
+        main()

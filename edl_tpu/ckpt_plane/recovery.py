@@ -152,12 +152,20 @@ def peer_restore(client, template: Any, mesh=None, spec_tree=None,
         host = assemble_leaves(parts)
         _, treedef = jax.tree_util.tree_flatten(template)
         state = jax.tree_util.tree_unflatten(treedef, host)
+        # The reshard window: host leaves -> device arrays laid out for the
+        # TARGET mesh (which need not match the world the shards were saved
+        # under — a 8-chip {dcn:2,data:4} plane restores onto a 6-chip
+        # {data:6} mesh through exactly this device_put). Timed separately
+        # so the rescale timeline can attribute it as its own phase.
+        reshard_start = reshard_end = time.time()
         if mesh is not None and spec_tree is not None:
             from edl_tpu.runtime.checkpoint import abstract_like, state_shardings
 
             shardings = state_shardings(abstract_like(template), mesh,
                                         spec_tree)
             state = jax.tree_util.tree_map(jax.device_put, state, shardings)
+            jax.block_until_ready(state)  # the window must cover the copies
+            reshard_end = time.time()
     except Exception:  # edl: noqa[EDL005] the plane is the fast rung of the fallback ladder; any defect in it must demote to the blob restore, never fail recovery outright
         log.warning("ckpt-plane restore failed; falling back to blob restore",
                     exc_info=True)
@@ -171,4 +179,6 @@ def peer_restore(client, template: Any, mesh=None, spec_tree=None,
                       component="worker", step=step, bytes=total,
                       world_at_save=world_at_save)
     return state, {"step": step, "bytes": total, "seconds": seconds,
-                   "world_at_save": world_at_save, "source": "peer"}
+                   "world_at_save": world_at_save, "source": "peer",
+                   "reshard_start": reshard_start,
+                   "reshard_end": reshard_end}

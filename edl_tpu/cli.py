@@ -295,6 +295,65 @@ def cmd_status(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_plan(args) -> int:
+    """Dump the layout planner's scored candidate table for a chip count —
+    the cost model made inspectable without running a job."""
+    from edl_tpu.parallel import ModelProfile, Topology, plan_layout
+    from edl_tpu.parallel.planner import data_only_plan
+
+    try:
+        slices = tuple(int(s) for s in args.slices.split(",") if s)
+        topology = Topology(
+            slices=slices,
+            chip_flops=args.chip_flops,
+            hbm_bytes=args.hbm_gib * 2**30,
+        )
+        profile = ModelProfile(
+            param_bytes=args.param_mb * 1e6,
+            replicated_bytes=args.replicated_mb * 1e6,
+            n_layers=args.layers,
+            flops_per_sample=args.flops_per_sample,
+            activation_bytes_per_microbatch=args.activation_mb * 1e6,
+        )
+        schedules = None
+        if args.no_pipeline:
+            schedules = ()
+        chips = args.chips if args.chips else topology.chips
+        plan = plan_layout(chips, topology, profile, args.global_batch,
+                           schedules=schedules)
+    except ValueError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 1
+    baseline = data_only_plan(chips, topology, profile, args.global_batch)
+    if args.json:
+        print(json.dumps(dict(plan.to_dict(),
+                              data_only=baseline.to_dict()), indent=2))
+        return 0
+    print(f"plan for {chips} chips on slices {slices}, "
+          f"batch {args.global_batch}:")
+    print(f"  chosen   : {plan.describe()}  "
+          f"({plan.step_seconds * 1e3:.3f} ms/step modeled)")
+    base_ms = (f"{baseline.step_seconds * 1e3:.3f} ms"
+               if baseline.feasible else f"infeasible ({baseline.reason})")
+    print(f"  data-only: {baseline.candidate.describe()}  ({base_ms})")
+    print()
+    header = (f"  {'layout':<30} {'step_ms':>9} {'compute':>8} "
+              f"{'bubble':>7} {'coll_ms':>8} {'p2p_ms':>7}  note")
+    print(header)
+    for sc in plan.table:
+        d = sc.to_dict()
+        step = f"{d['step_ms']:.3f}" if d["step_ms"] is not None else "-"
+        note = "" if sc.feasible else f"INFEASIBLE: {sc.reason}"
+        if sc.feasible and sc.candidate.axes == plan.mesh_axes \
+                and sc.candidate.schedule == plan.schedule \
+                and sc.candidate.microbatches == plan.microbatches:
+            note = "<- chosen"
+        print(f"  {d['layout']:<30} {step:>9} {d['compute_ms']:>8.3f} "
+              f"{d['bubble_fraction']:>7.3f} {d['collective_ms']:>8.3f} "
+              f"{d['p2p_ms']:>7.3f}  {note}")
+    return 0
+
+
 def cmd_train(args) -> int:
     import numpy as np
 
@@ -427,6 +486,33 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--token", default=None,
                    help="job auth token (default: $EDL_COORD_TOKEN)")
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "plan",
+        help="score hybrid-parallel layouts for a chip count (cost model)",
+        parents=[common])
+    p.add_argument("--slices", default="4,4",
+                   help="chips per ICI slice, comma-separated (the fabric)")
+    p.add_argument("--chips", type=int, default=0,
+                   help="chips to plan for (default: all of --slices)")
+    p.add_argument("--global-batch", type=int, default=1024)
+    p.add_argument("--param-mb", type=float, default=400.0,
+                   help="ZeRO-shardable parameter megabytes")
+    p.add_argument("--replicated-mb", type=float, default=0.0,
+                   help="megabytes of leaves that stay replicated")
+    p.add_argument("--layers", type=int, default=1,
+                   help="stackable layer count (bounds pipeline depth)")
+    p.add_argument("--flops-per-sample", type=float, default=0.0,
+                   help="train-step FLOPs per sample (0 = collective-bound)")
+    p.add_argument("--activation-mb", type=float, default=0.0,
+                   help="stage-boundary activation megabytes per microbatch")
+    p.add_argument("--chip-flops", type=float, default=1.0e12)
+    p.add_argument("--hbm-gib", type=float, default=16.0)
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="search dp shapes only (no pipeline schedules)")
+    p.add_argument("--json", action="store_true",
+                   help="dump the full scored table as JSON")
+    p.set_defaults(fn=cmd_plan)
 
     p = sub.add_parser("train", help="train a zoo model locally", parents=[common])
     p.add_argument("--model", default="fit_a_line")

@@ -39,7 +39,11 @@ __all__ = [
 
 #: The rescale lifecycle's phase vocabulary, in causal order. The e2e test
 #: and the bench assert all of these appear under one rescale trace id.
-RESCALE_PHASES = ("drain", "checkpoint", "warm_compile", "restore", "first_step")
+#: ``replan`` is the layout search (planner argmin over candidate meshes —
+#: degenerate-but-present on data-only resizes) and ``reshard`` is the
+#: device_put window that moves restored state onto the new mesh layout.
+RESCALE_PHASES = ("drain", "checkpoint", "replan", "warm_compile", "restore",
+                  "reshard", "first_step")
 
 
 def rescale_trace_id(epoch: int) -> str:
@@ -102,11 +106,14 @@ class Tracer:
                component: str = "", **attrs: Any) -> Span:
         """Record an interval measured by the caller (after-the-fact spans:
         the drain interval is only attributable once the new epoch is
-        known). Zero-length intervals are clamped to a nanosecond so phase
+        known). Zero-length intervals are clamped to a microsecond so phase
         durations are strictly positive — "this phase happened" must never
-        round down to "it took no time"."""
+        round down to "it took no time". A microsecond, not a nanosecond:
+        these are epoch-seconds floats (~2e9), where double precision eats
+        anything under ~2.4e-7 and a 1e-9 clamp silently rounds back to
+        zero length."""
         if end <= start:
-            end = start + 1e-9
+            end = start + 1e-6
         span = Span(name=name, start=start, end=end, trace_id=trace_id,
                     component=component or self.component, attrs=dict(attrs))
         sink = self.sink
@@ -211,6 +218,13 @@ def rescale_timeline(spans: Iterable[Union[Span, dict]],
     written against; per-phase seconds attribute it (phases may overlap:
     warm_compile runs concurrent with restore by design, so the sum of
     phases can exceed the wall).
+
+    Every recorded phase appears in ``phases`` — nothing is filtered against
+    ``RESCALE_PHASES`` here — and names outside that vocabulary are
+    additionally listed under ``unknown_phases`` so a misspelled or
+    unregistered phase surfaces in the timeline instead of silently failing
+    downstream completeness gates (which iterate ``RESCALE_PHASES`` and
+    would otherwise never look at the stray name).
     """
     by_trace: Dict[str, List[dict]] = {}
     for s in spans:
@@ -247,6 +261,8 @@ def rescale_timeline(spans: Iterable[Union[Span, dict]],
         ends = [d.get("end", 0.0) for d in recs]
         out[tid] = {
             "phases": phases,
+            "unknown_phases": sorted(
+                n for n in phases if n not in RESCALE_PHASES),
             "components": sorted({d.get("component", "") for d in recs} - {""}),
             "wall_seconds": (max(ends) - min(starts)) if recs else 0.0,
             "span_count": len(recs),

@@ -33,20 +33,28 @@ from edl_tpu.parallel.sharding import (
 )
 from edl_tpu.parallel.embedding import ShardedEmbedding
 from edl_tpu.parallel.pipeline import pipeline_apply
+from edl_tpu.parallel.planner import (
+    ModelProfile, Plan, Topology, data_only_plan, plan_layout,
+)
 from edl_tpu.parallel.ring_attention import dense_attention, ring_attention
 
 __all__ = [
     "MeshSpec",
+    "ModelProfile",
+    "Plan",
     "ShardedEmbedding",
+    "Topology",
     "assign_buckets",
     "batch_sharding",
     "build_hierarchical_mesh",
     "build_mesh",
     "collective_bytes",
+    "data_only_plan",
     "dense_attention",
     "local_mesh",
     "named_sharding",
     "pipeline_apply",
+    "plan_layout",
     "replicate",
     "ring_attention",
     "ring_bytes",

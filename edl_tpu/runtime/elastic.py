@@ -39,7 +39,8 @@ from edl_tpu.coordinator.watch import make_epoch_watch
 from edl_tpu.models.base import Model
 from edl_tpu.obs.instruments import WorkerInstruments
 from edl_tpu.obs.tracing import Tracer, get_tracer, rescale_trace_id
-from edl_tpu.parallel.mesh import MeshSpec, build_mesh
+from edl_tpu.parallel.mesh import MeshSpec, build_hierarchical_mesh, build_mesh
+from edl_tpu.parallel.planner import Plan
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.data import LeaseReader, split_pass
 from edl_tpu.runtime.ft_policy import PARK, FTPolicy, FTPolicyConfig
@@ -130,6 +131,12 @@ class ElasticConfig:
     #: group-death fallback. 0 (the default) disables the plane entirely —
     #: restores read the blob store exactly as before.
     peer_replicas: int = 0
+    #: persistent AOT compile cache directory (``runtime.compile_cache``):
+    #: non-empty stores every warm-compiled step executable on disk keyed by
+    #: (topology, program, avals, code fingerprint), so revisiting a layout
+    #: — including after a RESCALE_EXIT_CODE restart — costs zero compiles.
+    #: "" (the default) disables persistence; warm-compile behaves as before.
+    compile_cache_dir: str = ""
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
 
     def __post_init__(self) -> None:
@@ -214,6 +221,12 @@ class RescaleEvent:
     #: own field so the recovery interval it no longer sits inside stays
     #: honest (bench_rescale.py).
     compile_seconds: float = 0.0
+    #: how the warm compile was satisfied: "hit" (persistent AOT cache
+    #: served a ready executable — revisit of a known layout), "miss"
+    #: (compiled and stored), "off" (no cache configured / warm skipped).
+    compile_cache: str = "off"
+    #: the mesh layout adopted at this rescale, e.g. {"dcn": 2, "data": 4}.
+    layout: Dict[str, int] = field(default_factory=dict)
 
 
 class ElasticWorker:
@@ -229,6 +242,8 @@ class ElasticWorker:
         mesh_axes: Optional[Dict[str, int]] = None,
         profiler=None,  # optional edl_tpu.tools.profiler.StepProfiler
         tracer: Optional[Tracer] = None,
+        layout_planner: Optional[
+            Callable[[int, Sequence[jax.Device]], Optional[Plan]]] = None,
     ):
         if not config.checkpoint_dir:
             raise ValueError("ElasticConfig.checkpoint_dir is required")
@@ -241,6 +256,29 @@ class ElasticWorker:
         self.config = config
         self.planner = device_planner or default_device_planner(4)
         self.mesh_axes = mesh_axes  # extra non-data axes, sized per full mesh
+        #: hybrid-parallel replanner: ``(n_chips, devices) -> Plan | None``
+        #: (typically ``parallel.planner.plan_layout`` closed over a Topology
+        #: + ModelProfile). Called at every rescale; a returned Plan's mesh
+        #: axes and batch axis replace the static data-only resize, a None
+        #: falls back to it. Mutually exclusive with ``mesh_axes`` — the
+        #: plan owns the whole layout.
+        self.layout_planner = layout_planner
+        if layout_planner is not None and mesh_axes:
+            raise ValueError(
+                "pass either mesh_axes (static layout) or layout_planner "
+                "(searched layout), not both")
+        #: the Plan adopted at the last mesh build (None on the data-only
+        #: path) — replan-span attribution and `edl-tpu status` style debugging.
+        self.last_plan: Optional[Plan] = None
+        #: persistent AOT executable store shared by every Trainer this
+        #: worker builds across rescales (None when disabled).
+        if config.compile_cache_dir:
+            from edl_tpu.runtime.compile_cache import CompileCache
+
+            self.compile_cache: Optional[CompileCache] = CompileCache(
+                config.compile_cache_dir)
+        else:
+            self.compile_cache = None
         self.profiler = profiler
         #: rescale lifecycle spans land here (shared process tracer unless a
         #: test/bench passes its own); correlated cross-process via the
@@ -614,6 +652,18 @@ class ElasticWorker:
 
     def _build_mesh(self, world: int) -> Mesh:
         devices = list(self.planner(world))
+        self.last_plan = None
+        if self.layout_planner is not None:
+            plan = self.layout_planner(len(devices), devices)
+            if plan is not None:
+                self.last_plan = plan
+                spec = MeshSpec(dict(plan.mesh_axes))
+                if plan.hierarchical:
+                    # dcn outermost: the planner only emits a dcn axis when
+                    # the chips span slices, and the gradient psum over
+                    # ("dcn", "data") must lower to the hierarchical reduce.
+                    return build_hierarchical_mesh(spec, devices)
+                return build_mesh(spec, devices)
         axes = dict(self.mesh_axes or {})
         n = len(devices)
         fixed = 1
@@ -623,6 +673,17 @@ class ElasticWorker:
             raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
         axes["data"] = n // fixed
         return build_mesh(MeshSpec(axes), devices)
+
+    def _trainer_config(self) -> TrainerConfig:
+        """The trainer config for the CURRENT layout: a planned layout
+        re-points the batch axis (a hierarchical plan shards the batch over
+        ("dcn", "data")); the data-only path uses the static config as-is."""
+        if self.last_plan is None:
+            return self.config.trainer
+        if self.config.trainer.batch_axis == self.last_plan.batch_axis:
+            return self.config.trainer
+        return dataclasses.replace(
+            self.config.trainer, batch_axis=self.last_plan.batch_axis)
 
     def _restore_or_init(
         self, trainer: Trainer, fresh: Optional[TrainState] = None
@@ -648,6 +709,11 @@ class ElasticWorker:
                 self.policy.note_peer_restore(time.time() - t0)
                 self._last_restore = {"source": "peer",
                                       "bytes": int(info["bytes"])}
+                if "reshard_start" in info:
+                    # the device_put window peer_restore timed — the rescale
+                    # loop records it as the `reshard` phase.
+                    self._last_restore["reshard_start"] = info["reshard_start"]
+                    self._last_restore["reshard_end"] = info["reshard_end"]
                 log.info(
                     "restored step=%s from %d peer shard(s) onto %d-device "
                     "mesh (%d bytes in memory, zero blob reads)",
@@ -701,7 +767,8 @@ class ElasticWorker:
                 out["seconds"] = trainer.warm_compile(fresh, self._batch_avals)
                 self.tracer.record("warm_compile", t0, time.time(),
                                    trace_id=trace_id, component="worker",
-                                   compile_seconds=out["seconds"])
+                                   compile_seconds=out["seconds"],
+                                   cache=trainer.last_compile_cache)
             except Exception:  # edl: noqa[EDL005] warm-compile is an optimization; a failure must degrade to the lazy step-1 compile, not kill the rescale
                 self.tracer.record("warm_compile", t0, time.time(),
                                    trace_id=trace_id, component="worker",
@@ -860,7 +927,26 @@ class ElasticWorker:
                 self.tracer.record("checkpoint", ck_t0, ck_t1, trace_id=rid,
                                    component="worker")
             rescale_t0 = time.perf_counter()
+            # Replan: the layout search (planner argmin when a layout_planner
+            # is wired, the static data-only resize otherwise — recorded
+            # either way so every rescale timeline carries the phase and a
+            # missing planner shows up as a ~0 s replan, not a missing one).
+            t_replan0 = time.time()
             mesh = self._build_mesh(world)
+            replan_attrs: Dict = {"layout": json.dumps(dict(mesh.shape))}
+            if self.last_plan is not None:
+                replan_attrs.update(
+                    planned=True,
+                    schedule=self.last_plan.schedule or "none",
+                    microbatches=self.last_plan.microbatches,
+                    modeled_step_seconds=self.last_plan.step_seconds,
+                    baseline_step_seconds=self.last_plan.baseline_step_seconds,
+                )
+            else:
+                replan_attrs["planned"] = False
+            self.tracer.record("replan", t_replan0, time.time(),
+                               trace_id=rid, component="worker",
+                               **replan_attrs)
             codec_channel = None
             if self.config.trainer.wire_transport:
                 from edl_tpu.runtime.wire import KVCodecChannel
@@ -869,8 +955,9 @@ class ElasticWorker:
                 # but persisting the widen floor through the coordinator means
                 # a restarted incarnation never re-learns an old overflow.
                 codec_channel = KVCodecChannel(self.client, self._epoch)
-            trainer = Trainer(self.model, mesh, self.config.trainer,
-                              codec_channel=codec_channel)
+            trainer = Trainer(self.model, mesh, self._trainer_config(),
+                              codec_channel=codec_channel,
+                              compile_cache=self.compile_cache)
             # Live re-step pricing: every completed step feeds its wall
             # seconds to the policy's EMA (train_loop cost hook).
             trainer.step_cost_cb = self.policy.note_step
@@ -893,6 +980,21 @@ class ElasticWorker:
                 bytes_from_peers=(self._last_restore["bytes"]
                                   if self._last_restore["source"] == "peer"
                                   else 0),
+            )
+            # Reshard: the device_put window that moved restored leaves onto
+            # THIS mesh's layout. Peer restores time it explicitly
+            # (ckpt_plane.recovery reports the window); a blob restore fuses
+            # it into orbax's reshard-on-load and an init has nothing to
+            # move — both record the zero-length marker (clamped to 1 ns by
+            # the tracer) so the phase appears on every rescale timeline.
+            t_restore1 = time.time()
+            self.tracer.record(
+                "reshard",
+                self._last_restore.get("reshard_start", t_restore1),
+                self._last_restore.get("reshard_end", t_restore1),
+                trace_id=rid, component="worker",
+                source=self._last_restore["source"],
+                fused=(self._last_restore["source"] == "blob"),
             )
             if self._last_restore["source"] != "peer":
                 # Peer restores feed their own EMA (note_peer_restore); only
@@ -941,6 +1043,9 @@ class ElasticWorker:
                                         to_world=world,
                                         recovery_seconds=recovery,
                                         compile_seconds=compile_seconds,
+                                        compile_cache=trainer.last_compile_cache,
+                                        layout={str(k): int(v) for k, v
+                                                in mesh.shape.items()},
                                     )
                                 )
                         self.steps_done += 1

@@ -52,7 +52,7 @@ from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.coordinator.watch import make_epoch_watch
 from edl_tpu.models.base import Model
 from edl_tpu.obs.instruments import WorkerInstruments
-from edl_tpu.parallel import MeshSpec, build_mesh
+from edl_tpu.parallel import MeshSpec, build_hierarchical_mesh, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.elastic import ElasticConfig
 from edl_tpu.runtime.ft_policy import WARM_RESTART, FTPolicy, FTPolicyConfig
@@ -88,6 +88,7 @@ class MultiHostWorker:
         config: ElasticConfig,
         mesh_axes: Optional[Dict[str, int]] = None,
         profiler=None,
+        layout_planner=None,  # (n_chips, devices) -> parallel.planner.Plan | None
     ):
         if not config.checkpoint_dir:
             raise ValueError("ElasticConfig.checkpoint_dir is required")
@@ -102,6 +103,25 @@ class MultiHostWorker:
         self.source = source
         self.config = config
         self.mesh_axes = mesh_axes
+        #: hybrid-parallel replanner (same contract as ElasticWorker's):
+        #: every warm-restart incarnation re-plans for the world it finds,
+        #: so the gang converges on the same layout from the same inputs
+        #: (plan_layout is deterministic — no cross-rank agreement needed).
+        self.layout_planner = layout_planner
+        if layout_planner is not None and mesh_axes:
+            raise ValueError(
+                "pass either mesh_axes (static layout) or layout_planner "
+                "(searched layout), not both")
+        self.last_plan = None
+        #: persistent AOT executable store (None when disabled) — the warm
+        #: restart is exactly the revisit it amortizes: the relaunched
+        #: process lands on the executable its predecessor compiled.
+        if config.compile_cache_dir:
+            from edl_tpu.runtime.compile_cache import CompileCache
+
+            self.compile_cache = CompileCache(config.compile_cache_dir)
+        else:
+            self.compile_cache = None
         self.profiler = profiler
         #: same metric families as ElasticWorker — dashboards don't care
         #: which worker flavor a pod runs.
@@ -238,6 +258,15 @@ class MultiHostWorker:
 
     def _build_mesh(self) -> Mesh:
         devices = jax.devices()  # global: every process's chips
+        self.last_plan = None
+        if self.layout_planner is not None:
+            plan = self.layout_planner(len(devices), devices)
+            if plan is not None:
+                self.last_plan = plan
+                spec = MeshSpec(dict(plan.mesh_axes))
+                if plan.hierarchical:
+                    return build_hierarchical_mesh(spec, devices)
+                return build_mesh(spec, devices)
         axes = dict(self.mesh_axes or {})
         fixed = 1
         for size in axes.values():
@@ -248,6 +277,17 @@ class MultiHostWorker:
             )
         axes["data"] = len(devices) // fixed
         return build_mesh(MeshSpec(axes), devices)
+
+    def _trainer_config(self):
+        """Trainer config for the current layout (planned layouts re-point
+        the batch axis; see ElasticWorker._trainer_config)."""
+        if (self.last_plan is None
+                or self.config.trainer.batch_axis == self.last_plan.batch_axis):
+            return self.config.trainer
+        import dataclasses
+
+        return dataclasses.replace(
+            self.config.trainer, batch_axis=self.last_plan.batch_axis)
 
     def _restore_or_init(self, trainer: Trainer) -> TrainState:
         fresh = trainer.init_state()
@@ -583,8 +623,9 @@ class MultiHostWorker:
             # codec from scratch (possibly under a new rank 0) while the
             # widen floor persists across epochs.
             codec_channel = KVCodecChannel(self.client, epoch)
-        trainer = Trainer(self.model, mesh, self.config.trainer,
-                          codec_channel=codec_channel)
+        trainer = Trainer(self.model, mesh, self._trainer_config(),
+                          codec_channel=codec_channel,
+                          compile_cache=self.compile_cache)
         # Live re-step pricing for the policy's park break-even
         # (train_loop cost hook).
         trainer.step_cost_cb = self.policy.note_step

@@ -31,6 +31,7 @@ from edl_tpu.obs.metrics import (
 )
 from edl_tpu.obs.tracing import (
     RESCALE_PHASES,
+    Span,
     Tracer,
     load_spans,
     rescale_timeline,
@@ -249,8 +250,21 @@ def test_rescale_timeline_stitches_components_and_dedupes():
 def test_rescale_phase_vocabulary_is_stable():
     # the bench artifact and the e2e test are written against these names
     assert RESCALE_PHASES == (
-        "drain", "checkpoint", "warm_compile", "restore", "first_step"
+        "drain", "checkpoint", "replan", "warm_compile", "restore",
+        "reshard", "first_step"
     )
+
+
+def test_rescale_timeline_surfaces_unknown_phases():
+    tid = "rescale-e000021"
+    spans = [
+        Span("drain", 1.0, 1.1, trace_id=tid, component="worker"),
+        Span("teleport", 1.1, 1.2, trace_id=tid, component="worker"),
+    ]
+    t = rescale_timeline(spans)[tid]
+    # the stray name is kept in phases AND called out, not dropped
+    assert t["phases"]["teleport"]["seconds"] == pytest.approx(0.1)
+    assert t["unknown_phases"] == ["teleport"]
 
 
 # -- HTTP endpoints ------------------------------------------------------------

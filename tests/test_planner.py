@@ -1,0 +1,106 @@
+"""Layout-planner oracle: the cost-model argmin must strictly beat a
+data-only resize at every point of the committed sweep, deterministically.
+
+The sweep points and profile are imported from bench_rescale so the tier-1
+oracle and the committed BENCH_RESCALE.json replan_sweep section can never
+drift apart — a planner regression fails here before it fails the bench.
+"""
+
+import pytest
+
+from bench_rescale import REPLAN_SWEEP, _sweep_profile
+from edl_tpu.parallel import (
+    ModelProfile,
+    Topology,
+    data_only_plan,
+    plan_layout,
+)
+from edl_tpu.parallel.planner import (
+    data_only_step_seconds,
+    enumerate_candidates,
+)
+
+
+@pytest.mark.parametrize("chips,slices", REPLAN_SWEEP)
+def test_planner_strictly_beats_data_only(chips, slices):
+    topo = Topology(slices=slices)
+    plan = plan_layout(chips, topo, _sweep_profile(), 1536)
+    base = data_only_step_seconds(chips, topo, _sweep_profile(), 1536)
+    assert plan.step_seconds < base, (
+        f"{plan.describe()} at {chips} chips on {slices}: "
+        f"{plan.step_seconds * 1e3:.3f}ms !< data-only {base * 1e3:.3f}ms")
+    assert plan.baseline_step_seconds == pytest.approx(base)
+
+
+def test_plan_is_deterministic():
+    topo = Topology(slices=(4, 4))
+    a = plan_layout(8, topo, _sweep_profile(), 1536)
+    b = plan_layout(8, topo, _sweep_profile(), 1536)
+    assert a.to_dict() == b.to_dict()
+    # The table is sorted by modeled step time, chosen first: a stable tie
+    # break means every gang member lands on the same layout independently.
+    assert a.table[0].candidate.describe() == a.describe()
+
+
+def test_multi_slice_chip_count_adopts_hierarchical_dp():
+    # 8 chips over two 4-chip slices: a flat data ring would cross DCN on
+    # every hop, so the planner must pick a {dcn: 2, ...} layout whose
+    # cross-slice traffic is one gradient reduction.
+    plan = plan_layout(8, Topology(slices=(4, 4)), _sweep_profile(), 1536)
+    assert plan.axes_dict.get("dcn") == 2
+    assert plan.batch_axis[0] == "dcn"
+    assert plan.hierarchical
+
+
+def test_single_slice_shrink_goes_flat():
+    plan = plan_layout(
+        6, Topology(slices=(6,)),
+        ModelProfile(param_bytes=400e6, flops_per_sample=2e7), 240,
+        schedules=())
+    assert plan.axes_dict == {"data": 6}
+    assert not plan.hierarchical
+    assert plan.schedule is None
+    assert plan.batch_axis == "data"
+
+
+def test_schedules_empty_forbids_pipelining():
+    plan = plan_layout(8, Topology(slices=(4, 4)), _sweep_profile(), 1536,
+                       schedules=())
+    assert "pipe" not in plan.axes_dict
+    for scored in plan.table:
+        assert scored.candidate.schedule is None
+
+
+def test_infeasible_candidates_carry_reasons_and_lose():
+    # 400 MB of HBM cannot hold the deep-pipeline candidates' activation
+    # stash; infeasible rows must stay in the table with a reason and
+    # never be chosen.
+    topo = Topology(slices=(4, 4), hbm_bytes=400_000_000)
+    plan = plan_layout(8, topo, _sweep_profile(), 1536)
+    infeasible = [s for s in plan.table if not s.feasible]
+    assert infeasible, "expected at least one memory-infeasible candidate"
+    assert all(s.reason for s in infeasible)
+    assert plan.chosen().feasible
+
+
+def test_plan_layout_raises_when_nothing_fits():
+    with pytest.raises(ValueError):
+        plan_layout(4, Topology(slices=(4,), hbm_bytes=1 << 20),
+                    _sweep_profile(), 1536)
+
+
+def test_data_only_plan_matches_its_step_model():
+    topo = Topology(slices=(4, 4))
+    scored = data_only_plan(8, topo, _sweep_profile(), 1536)
+    assert scored.candidate.axes_dict == {"data": 8}
+    assert scored.candidate.schedule is None
+    assert scored.step_seconds == pytest.approx(
+        data_only_step_seconds(8, topo, _sweep_profile(), 1536))
+
+
+def test_enumerate_covers_flat_and_hierarchical_dp():
+    cands = enumerate_candidates(8, Topology(slices=(4, 4)),
+                                 _sweep_profile(), 1536)
+    layouts = {tuple(sorted(c.axes_dict.items())) for c in cands}
+    assert (("data", 8),) in layouts
+    assert (("data", 4), ("dcn", 2)) in layouts
