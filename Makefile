@@ -5,7 +5,7 @@ PYTHON ?= python
 
 ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane edl_tpu/parallel/planner.py edl_tpu/runtime/compile_cache.py bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke bench-replan-smoke verify bench-pipeline bench-coord bench-collective bench-serve
+.PHONY: analyze analyze-json baseline test chaos chaos-composed chaos-preempt lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke bench-replan-smoke bench-spot-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -34,6 +34,12 @@ chaos:
 ## EDL_COORD_SANITIZER=tsan to put the native coordinator under TSan.
 chaos-composed:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos_composed.py -q -m chaos
+
+## Revocation wave: two jobs revoked by one scripted ChaosScenario; both
+## drain inside their notice with steps_lost == 0 and exact step
+## accounting, and the fault timeline replays from its JSON spec.
+chaos-preempt:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_chaos_preempt.py -q
 
 ## Telemetry-plane deploy gate: boots a worker with its /metrics endpoint
 ## against a real coordinator, scrapes over HTTP while training runs, and
@@ -122,12 +128,20 @@ bench-coord-smoke:
 bench-replan-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) bench_rescale.py --replan
 
+## Spot-revocation arm only: a worker revoked mid-training drains inside
+## its notice (steps_lost == 0, peer-sourced restore on the shrunk
+## replanned mesh); merges spot_arm into BENCH_RESCALE.json +
+## RESCALE_TIMELINE.json.
+bench-spot-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) bench_rescale.py --spot
+
 ## Everything a PR must pass: static analysis (EDL001-EDL010 vs baseline +
 ## protocol_schema.json ratchet), tier-1 tests, protocol + durability model
 ## checks (in-process AND crash-armed native oracle), serving smoke, TSan
-## lane, bench-harness smokes (coordinator + replanner). Tier-2 (slow, run
-## before cutting a release): `make chaos` / `make chaos-composed`.
-verify: analyze test modelcheck modelcheck-native serve-smoke ckpt-plane-smoke tsan-smoke bench-coord-smoke bench-replan-smoke
+## lane, revocation-wave chaos, bench-harness smokes (coordinator +
+## replanner + spot drain). Tier-2 (slow, run before cutting a release):
+## `make chaos` / `make chaos-composed`.
+verify: analyze test modelcheck modelcheck-native serve-smoke ckpt-plane-smoke tsan-smoke chaos-preempt bench-coord-smoke bench-replan-smoke bench-spot-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

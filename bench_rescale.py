@@ -37,6 +37,12 @@ collector loop example/fit_a_line/collector.py:215-226):
 - ``replan_sweep``: the modeled oracle — at every sweep point (chip count x
   fabric shape) the planner's chosen layout's modeled step time must
   STRICTLY beat the naive data-only resize scored under the same model.
+- ``spot_arm``: the advance-notice revocation path live — the trainer
+  receives a ``preempt_notice`` push mid-training, FTPolicy prices the
+  notice budget, shards evacuate off the doomed rank, the drain beats the
+  deadline, and a replacement peer-restores on the shrunk replanned mesh
+  with EXACT step accounting (``steps_lost: 0``). ``--spot`` runs only
+  this arm (the ``make bench-spot-smoke`` gate).
 
 Run on the CPU simulation mesh by default (8 virtual devices; CI-stable);
 the same script runs unmodified on real chips. Writes BENCH_RESCALE.json
@@ -372,6 +378,202 @@ def run_replan_arm(devs) -> tuple:
     return arm, tl_section
 
 
+def run_spot_arm(devs) -> tuple:
+    """The spot-revocation arm: a live training run receives an
+    advance-notice revocation mid-training and drains inside the notice.
+
+    Topology: ``trainer-0`` (the single-controller ElasticWorker, 8 chips
+    as two virtual slices, planner layout ``{dcn:2,data:4}``) trains with
+    member ``trainer-1`` heartbeat-following. Mid-run the bench — playing
+    the cloud scheduler — issues ``preempt_notice(["trainer-0"],
+    notice_s)`` through the admin client. The coordinator's watch push
+    fans the ``{"notify":"preempt"}`` frame to the doomed worker, whose
+    FTPolicy prices the notice budget (drain-and-shrink wins), evacuates
+    its ZeRO shards onto the surviving replica ring (placement override:
+    rank 0 banned), checkpoints durably, and leaves before the deadline.
+    A replacement worker (``trainer-2``, the spot slice gone: 4 chips,
+    replanned ``{data:4}``) peer-restores from coordinator memory and
+    drains the rest of the queue. ``steps_lost == 0`` is PROVEN by exact
+    step accounting: doomed + survivor steps must equal the workload —
+    at-least-once would inflate it, a lost shard would starve it.
+
+    Returns ``(arm_result_dict, timeline_section_dict)``.
+    """
+    import tempfile
+
+    from edl_tpu.coordinator import CoordinatorServer
+    from edl_tpu.models import fit_a_line
+    from edl_tpu.obs.tracing import Tracer, rescale_timeline
+    from edl_tpu.parallel import ModelProfile, Topology, plan_layout
+    from edl_tpu.runtime import (
+        ElasticConfig, ElasticWorker, SyntheticShardSource, TrainerConfig,
+        shard_names,
+    )
+
+    model = fit_a_line.MODEL
+    tag = "spot"
+    batch_size = int(os.environ.get("EDL_SPOT_BATCH", "240"))
+    n_shards = int(os.environ.get("EDL_SPOT_SHARDS", "24"))
+    batches_per_shard = int(os.environ.get("EDL_SPOT_BPS", "24"))
+    notice_s = float(os.environ.get("EDL_SPOT_NOTICE_S", "20"))
+    expected_steps = n_shards * batches_per_shard
+    profile = ModelProfile(param_bytes=400e6, flops_per_sample=2e7)
+
+    def layout_planner(n_chips, devices):
+        topo = (Topology(slices=(4, 4)) if n_chips == 8
+                else Topology(slices=(n_chips,)))
+        return plan_layout(n_chips, topo, profile, batch_size, schedules=())
+
+    workdir = tempfile.mkdtemp(prefix="edl-spot-")
+    trace = Tracer(component="bench")
+
+    def make_worker(server, name, planner):
+        return ElasticWorker(
+            model,
+            server.client(name),
+            SyntheticShardSource(model, batch_size=batch_size,
+                                 batches_per_shard=batches_per_shard),
+            ElasticConfig(
+                checkpoint_dir=os.path.join(workdir, "ck"),
+                checkpoint_interval=50, heartbeat_interval=0.05,
+                rescale_barrier_timeout=30.0,
+                trainer=TrainerConfig(optimizer="sgd", learning_rate=0.05),
+                peer_replicas=1,
+            ),
+            device_planner=planner,
+            tracer=trace,
+            layout_planner=layout_planner,
+        )
+
+    with CoordinatorServer(task_lease_sec=120.0,
+                           heartbeat_ttl_sec=120.0) as server:
+        admin = server.client("admin")
+        admin.add_tasks(shard_names(tag, n_shards))
+        doomed = make_worker(server, "trainer-0",
+                             lambda w: devs[:8] if w >= 2 else devs[:4])
+        stop = threading.Event()
+
+        def follow():
+            """trainer-1: the surviving member (replica-ring peer), the
+            same heartbeat-follow loop the replan arm's joiners run."""
+            j = server.client("trainer-1")
+            info = j.register()
+            epoch = info["epoch"]
+            while not stop.is_set():
+                reply = j.sync(epoch, timeout=5.0)
+                if reply.get("ok"):
+                    break
+                epoch = reply.get("epoch", epoch)
+            while not stop.is_set():
+                hb = j.heartbeat()
+                if hb.get("ok") and hb["epoch"] != epoch:
+                    epoch = hb["epoch"]
+                    j.sync(epoch, timeout=5.0)
+                time.sleep(0.1)
+
+        follower = threading.Thread(target=follow, daemon=True)
+        follower.start()
+        revoked_at = {}
+
+        def scheduler():
+            """The cloud control plane: wait until training is warm on the
+            full mesh, then revoke the trainer with advance notice."""
+            t0 = time.time()
+            while doomed.steps_done < 10 and not stop.is_set():
+                if time.time() - t0 > 180:
+                    return
+                time.sleep(0.02)
+            revoked_at["t"] = time.monotonic()
+            admin.preempt_notice(["trainer-0"], notice_s=notice_s,
+                                 reason="spot-reclaim")
+
+        sched = threading.Thread(target=scheduler, daemon=True)
+        sched.start()
+        try:
+            doomed_metrics = doomed.run()
+        finally:
+            sched.join(timeout=30)
+        assert doomed_metrics.get("preempted") == 1.0, (
+            f"doomed worker was not preempted: {doomed_metrics}")
+
+        # The survivor: spot slice gone, 4 chips, replanned {data:4},
+        # restored from the checkpoint plane (coordinator memory).
+        survivor = make_worker(server, "trainer-2", lambda w: devs[:4])
+        try:
+            survivor_metrics = survivor.run()
+        finally:
+            stop.set()
+            follower.join(timeout=10)
+
+    steps_total = int(doomed_metrics["steps"] + survivor_metrics["steps"])
+    steps_lost = int(doomed_metrics["steps_lost"])
+    notice_to_drained = float(doomed_metrics["notice_to_drained_seconds"])
+    deadline_met = doomed_metrics["preempt_deadline_met"] == 1.0
+    restore_source = survivor._last_restore["source"]
+    assert steps_total == expected_steps, (
+        f"step accounting broke: doomed {doomed_metrics['steps']} + "
+        f"survivor {survivor_metrics['steps']} != {expected_steps} "
+        f"(replayed or lost work)")
+    assert steps_lost == 0, doomed_metrics
+    assert deadline_met, (
+        f"drain missed the {notice_s}s notice: "
+        f"{notice_to_drained:.2f}s to drained")
+    assert restore_source == "peer", (
+        f"survivor restored from {restore_source!r}, not the checkpoint "
+        f"plane: {survivor._last_restore}")
+    assert survivor.last_plan is not None \
+        and survivor.last_plan.describe() == "data4", (
+            f"survivor did not replan the post-revocation mesh: "
+            f"{survivor.last_plan}")
+
+    # The doomed worker's drain trace: preempt_drain (notice arrival ->
+    # shard evacuation) + drain + checkpoint under the post-leave epoch.
+    timeline = rescale_timeline(trace.spans)
+    drain_traces = {
+        tid: tl for tid, tl in timeline.items()
+        if tl["phases"].get("preempt_drain", {}).get(
+            "attrs", {}).get("notice")
+    }
+    assert drain_traces, (
+        f"no trace carries a notice-attributed preempt_drain span: "
+        f"{ {tid: sorted(tl['phases']) for tid, tl in timeline.items()} }")
+    did, dtl = sorted(drain_traces.items())[-1]
+
+    arm = {
+        "scenario": ("trainer-0 revoked mid-training with advance notice; "
+                     "survivor peer-restores on the shrunk replanned mesh"),
+        "notice_s": notice_s,
+        "notice_to_drained_seconds": round(notice_to_drained, 4),
+        "pass_drained_before_deadline": deadline_met,
+        "decision_mode_code": doomed_metrics["preempt_mode_code"],
+        "steps_lost": steps_lost,
+        "pass_steps_lost_zero": steps_lost == 0,
+        "steps_doomed": int(doomed_metrics["steps"]),
+        "steps_survivor": int(survivor_metrics["steps"]),
+        "steps_expected": expected_steps,
+        "pass_exact_step_accounting": steps_total == expected_steps,
+        "survivor_restore_source": restore_source,
+        "survivor_restore_bytes_from_peers": int(
+            survivor._last_restore.get("bytes", 0)),
+        "survivor_layout": survivor.last_plan.describe(),
+        "pass_survivor_replanned": True,  # asserted above
+        "backend": jax.default_backend(),
+    }
+    tl_section = {
+        "scenario": arm["scenario"],
+        "drain_trace_id": did,
+        "phases": {
+            name: {
+                "seconds": round(ph["seconds"], 6),
+                "component": ph["component"],
+                "attrs": ph.get("attrs", {}),
+            }
+            for name, ph in dtl["phases"].items()
+        },
+    }
+    return arm, tl_section
+
+
 def _merge_into_json(path: str, updates: dict) -> dict:
     """Merge ``updates`` into an existing JSON artifact (the --replan smoke
     must not clobber the full bench's sections)."""
@@ -415,6 +617,32 @@ def replan_main() -> None:
                      {"replan_arm": tl_section})
     print(json.dumps({"replan_arm": result["replan_arm"],
                       "replan_sweep": result["replan_sweep"]}))
+
+
+def spot_main() -> None:
+    """`make bench-spot-smoke`: only the spot-revocation arm, merged into
+    the committed artifacts."""
+    from bench import probe_devices
+
+    on_cpu_sim = os.environ.get("EDL_RESCALE_PLATFORM", "cpu") == "cpu"
+    devs, reason = probe_devices(
+        init_timeout=float(os.environ.get("EDL_BENCH_INIT_TIMEOUT", "300")),
+        allow_cpu=on_cpu_sim,
+    )
+    if devs is None:
+        print(json.dumps({"error": reason}))
+        raise SystemExit(1)
+    if len(devs) < 8:
+        print(json.dumps({"error": f"spot arm needs 8 devices, have "
+                                   f"{len(devs)}"}))
+        raise SystemExit(1)
+    arm, tl_section = run_spot_arm(devs)
+    here = os.path.dirname(os.path.abspath(__file__))
+    result = _merge_into_json(
+        os.path.join(here, "BENCH_RESCALE.json"), {"spot_arm": arm})
+    _merge_into_json(os.path.join(here, "RESCALE_TIMELINE.json"),
+                     {"spot_arm": tl_section})
+    print(json.dumps({"spot_arm": result["spot_arm"]}))
 
 
 def main() -> None:
@@ -614,6 +842,9 @@ def main() -> None:
     replan_sweep = run_replan_sweep()
     replan_arm, replan_tl = run_replan_arm(devs)
 
+    # -- spot-revocation arm (advance-notice drain; doc/robustness.md) ---------
+    spot_arm, spot_tl = run_spot_arm(devs)
+
     result = {
         "max_recovery_seconds": round(max_recovery, 3),
         "retention_vs_static": round(retention, 4),
@@ -632,6 +863,7 @@ def main() -> None:
         },
         "replan_arm": replan_arm,
         "replan_sweep": replan_sweep,
+        "spot_arm": spot_arm,
         "details": {
             "devices": full,
             "rescale": f"{half}->{full} devices (world 1->2)",
@@ -691,6 +923,7 @@ def main() -> None:
             "concurrent with restore by design (see doc/observability.md)"
         ),
         "replan_arm": replan_tl,
+        "spot_arm": spot_tl,
     }
     tl_out = os.path.join(here, "RESCALE_TIMELINE.json")
     with open(tl_out, "w") as f:
@@ -703,5 +936,7 @@ if __name__ == "__main__":
 
     if "--replan" in sys.argv:
         replan_main()
+    elif "--spot" in sys.argv:
+        spot_main()
     else:
         main()

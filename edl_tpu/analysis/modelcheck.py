@@ -240,7 +240,7 @@ class ProtocolModel:
 
     _KNOWN_TAGS = {
         "epoch", "lease", "dedup", "kv", "queue", "membership", "parks",
-        "composite", "shard", "watch", "routing", "durability",
+        "composite", "shard", "watch", "routing", "durability", "preempt",
     }
 
     def __init__(self, effects: Dict[str, Dict[str, Any]],
@@ -299,6 +299,10 @@ class ProtocolModel:
         self.shard_put_seen: set = set()
         # Watch subscriptions: worker -> pending notification frames.
         self.watch_queues: Dict[str, List[Dict[str, Any]]] = {}
+        # Pending advance-notice revocations: worker -> {notice_s, reason,
+        # seq}. Volatile (native preempts_ is never journaled).
+        self.preempts: Dict[str, Dict[str, Any]] = {}
+        self.preempt_seq = 0
 
     def copy(self) -> "ProtocolModel":
         m = ProtocolModel.__new__(ProtocolModel)
@@ -338,6 +342,8 @@ class ProtocolModel:
         m.watch_queues = {
             w: [dict(f) for f in q] for w, q in self.watch_queues.items()
         }
+        m.preempts = {w: dict(p) for w, p in self.preempts.items()}
+        m.preempt_seq = self.preempt_seq
         return m
 
     # Every handler returns (reply_prediction | None-if-parked, released)
@@ -571,6 +577,10 @@ class ProtocolModel:
         self.shards = {}
         self.shard_put_seen = set()
         self.watch_queues = {}
+        # preempt notices are volatile: a restarted coordinator forgets
+        # them and the scheduler re-issues (ladder honesty, like shards).
+        self.preempts = {}
+        self.preempt_seq = 0
         # boot of the new incarnation: load_state queues record_epoch();
         # crash-injection env does not survive the restart, so compaction
         # reverts to the (never-reached) native default threshold.
@@ -676,6 +686,13 @@ class ProtocolModel:
         for q in self.watch_queues.values():
             q.append(self._notify_frame(self.epoch))
 
+    def _preempt_frame(self, worker: str) -> Dict[str, Any]:
+        p = self.preempts[worker]
+        return {"ok": True, "notify": "preempt", "worker": worker,
+                "notice_s": p["notice_s"], "reason": p["reason"],
+                "seq": p["seq"], "epoch": self.epoch,
+                "cursor": self.epoch, "world": len(self.members)}
+
     def _requeue_worker_leases(self, worker: str) -> None:
         stale = [t for t, w in self.leased.items() if w == worker]
         for t in stale:
@@ -732,6 +749,7 @@ class ProtocolModel:
                 self._notify_watchers()
             self._requeue_worker_leases(target)
             self.acquire_cache.pop(target, None)
+            self.preempts.pop(target, None)  # departure consumes the notice
             released = self._release_sync_on_epoch_change()
         return {"ok": True, "epoch": self.epoch}, released
 
@@ -980,7 +998,29 @@ class ProtocolModel:
     def _op_status(self, worker: str, fields: Dict[str, Any]):
         return ({"ok": True, "epoch": self.epoch,
                  "world": len(self.members), "queued": len(self.todo),
-                 "leased": len(self.leased), "done": len(self.done)}, [])
+                 "leased": len(self.leased), "done": len(self.done),
+                 "preempts": sorted(
+                     f"{w}={int(p['notice_s'])}"
+                     for w, p in self.preempts.items())}, [])
+
+    def _op_preempt_notice(self, worker: str, fields: Dict[str, Any]):
+        targets = fields.get("targets")
+        if not isinstance(targets, list) or not targets:
+            return ({"ok": False, "error": "targets array required",
+                     "epoch": self.epoch}, [])
+        notice_s = float(fields.get("notice_s", 0) or 0)
+        reason = fields.get("reason") or "preempt"
+        revoked: List[str] = []
+        for t in targets:
+            t = str(t)
+            self.preempt_seq += 1
+            self.preempts[t] = {"notice_s": notice_s, "reason": reason,
+                                "seq": self.preempt_seq}
+            q = self.watch_queues.get(t)
+            if q is not None:
+                q.append(self._preempt_frame(t))
+            revoked.append(t)
+        return {"ok": True, "revoked": revoked, "epoch": self.epoch}, []
 
     # Watch/notify ops (push-based epoch discovery). The twin has no socket
     # to push to, so delivery is modeled the way the shim serves it: a
@@ -1002,6 +1042,8 @@ class ProtocolModel:
         if cursor >= 0:
             for e in range(cursor + 1, self.epoch + 1):
                 q.append(self._notify_frame(e))
+        if worker in self.preempts:  # late subscriber: replay the notice
+            q.append(self._preempt_frame(worker))
         return ({"ok": True, "watch": True, "cursor": self.epoch,
                  "epoch": self.epoch}, [])
 
@@ -1692,6 +1734,32 @@ def watch_scripts() -> Dict[str, List[ScriptOp]]:
     return {"w0": w0, "w1": w1}
 
 
+def preempt_scripts() -> Dict[str, List[ScriptOp]]:
+    """Advance-notice revocation schedule: w1 issues a ``preempt_notice``
+    targeting w0 while w0 subscribes/drains its watch stream — the
+    interleavings cover both the live-push order (subscribe first) and the
+    late-subscriber replay order (notice first), plus the malformed
+    empty-targets reply, status rendering of pending revocations, and the
+    departure-consumes-notice rule on leave. Runs against the plain twin
+    (``take`` is the in-process drain verb, absent from the wire)."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("watch", cursor=0, worker="w0"),
+        mk("watch", take=True, worker="w0"),
+        mk("watch", take=True, worker="w0"),
+        mk("status"),
+        mk("leave", worker="w0"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("preempt_notice", targets=["w0"], notice_s=30, reason="spot"),
+        mk("preempt_notice", note="empty", targets=[]),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
 def watch_redirect_scripts() -> Dict[str, List[ScriptOp]]:
     """Redirect-during-watch schedule against a sharded ROOT
     (``SHARD_ENDPOINTS``): every keyspace op answers a redirect computed by
@@ -1994,6 +2062,29 @@ def durability_shard_scripts() -> Dict[str, List[ScriptOp]]:
     return {"w0": w0, "w1": w1}
 
 
+def durability_preempt_scripts() -> Dict[str, List[ScriptOp]]:
+    """Ladder honesty for the deliberately-unjournaled preempt table: a
+    pending revocation notice is scheduler state, so a crashed coordinator
+    forgets it (the scheduler re-issues) — ``status`` must show the
+    pending notice before the crash and an empty table after, never a
+    journal-resurrected ghost. No ``take`` frames here: this row replays
+    against the native crash oracle, whose wire has no drain verb."""
+    mk = ScriptOp.make
+    w0 = [
+        mk("register", worker="w0"),
+        mk("kv_put", key="pk", value="v1"),
+        mk("crash", mode="clean", worker="w0"),
+        mk("status"),
+        mk("kv_get", key="pk"),
+    ]
+    w1 = [
+        mk("register", worker="w1"),
+        mk("preempt_notice", targets=["w1"], notice_s=45, reason="maint"),
+        mk("status"),
+    ]
+    return {"w0": w0, "w1": w1}
+
+
 @dataclass
 class Schedule:
     """One named row of the acceptance configuration: scripts + the oracle
@@ -2028,6 +2119,8 @@ def durability_schedules() -> List[Schedule]:
                  _durable_twin_factory, durable=True, por=True),
         Schedule("durability-shard", durability_shard_scripts(),
                  _durable_twin_factory, durable=True, por=True),
+        Schedule("durability-preempt", durability_preempt_scripts(),
+                 _durable_twin_factory, durable=True, por=True),
     ]
 
 
@@ -2045,6 +2138,7 @@ def default_schedules(
         Schedule("default", default_scripts(), coordinator_factory),
         Schedule("ckpt-plane", ckpt_plane_scripts(), coordinator_factory),
         Schedule("watch", watch_scripts(), coordinator_factory),
+        Schedule("preempt", preempt_scripts(), coordinator_factory),
     ]
     if coordinator_factory is None:
         rows.append(Schedule("watch-redirect", watch_redirect_scripts(),

@@ -15,42 +15,67 @@ whole point.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+from typing import Dict, Iterable, List, Optional
 
 #: coordinator KV key the placement map lives under, scoped by membership
 #: epoch so a rescale's new map never aliases the old one.
 PLACEMENT_KEY = "edl/ckpt_plane/placement/e{epoch}"
 
 
-def replica_group(rank: int, world: int, k: int) -> List[int]:
+def replica_group(rank: int, world: int, k: int,
+                  exclude: Optional[Iterable[int]] = None) -> List[int]:
     """Holder ranks for ``rank``'s shard: the ``k`` ring successors.
 
     ``k`` is clamped to ``world - 1`` (a peer cannot replicate to itself,
     and more holders than peers is meaningless). world=1 yields no holders:
     a lone worker's plane degenerates to the coordinator's own copy.
+
+    ``exclude`` is the revocation override: ranks under an advance-notice
+    drain are skipped when walking the ring — a doomed host may still OWN
+    a shard (that data is exactly what must be copied off it) but never
+    HOLDS a replica. The walk continues past excluded ranks so the group
+    keeps ``k`` holders whenever enough survivors exist.
     """
     if world <= 1:
         return []
-    k = max(0, min(k, world - 1))
-    return [(rank + i) % world for i in range(1, k + 1)]
+    banned = {int(x) % world for x in exclude} if exclude else set()
+    k = max(0, min(k, world - 1 - len(banned - {rank % world})))
+    out: List[int] = []
+    for i in range(1, world):
+        if len(out) >= k:
+            break
+        cand = (rank + i) % world
+        if cand in banned:
+            continue
+        out.append(cand)
+    return out
 
 
-def placement_map(world: int, k: int) -> Dict[int, List[int]]:
+def placement_map(world: int, k: int,
+                  exclude: Optional[Iterable[int]] = None
+                  ) -> Dict[int, List[int]]:
     """owner rank -> holder ranks, for every rank in ``world``."""
-    return {r: replica_group(r, world, k) for r in range(world)}
+    ex = list(exclude) if exclude else None
+    return {r: replica_group(r, world, k, exclude=ex)
+            for r in range(world)}
 
 
 def publish_placement(client, epoch: int, world: int, k: int,
-                      prev_epoch: Optional[int] = None) -> Dict:
+                      prev_epoch: Optional[int] = None,
+                      exclude: Optional[Iterable[int]] = None) -> Dict:
     """Publish the epoch's placement map to coordinator KV and invalidate
     the previous epoch's (epoch change = rank renumbering = every group in
     the old map is stale). Idempotent: every member writes the identical
-    JSON, so concurrent publishes are harmless."""
+    JSON, so concurrent publishes are harmless. ``exclude`` (revoked ranks)
+    is recorded in the doc so late readers reproduce the same override."""
+    ex = sorted({int(x) for x in exclude}) if exclude else []
     doc = {
         "epoch": int(epoch),
         "world": int(world),
         "replicas": int(k),
-        "groups": {str(r): g for r, g in placement_map(world, k).items()},
+        "excluded": ex,
+        "groups": {str(r): g for r, g in
+                   placement_map(world, k, exclude=ex).items()},
     }
     client.kv_put(PLACEMENT_KEY.format(epoch=int(epoch)), json.dumps(doc))
     if prev_epoch is not None and int(prev_epoch) != int(epoch):

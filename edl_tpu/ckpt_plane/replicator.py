@@ -147,6 +147,27 @@ class CkptPlane:
         #: last epoch whose placement map this worker published (the key
         #: ``on_epoch`` invalidates when the epoch moves on).
         self._published_epoch: Optional[int] = None
+        #: ranks under an advance-notice revocation: excluded from every
+        #: replica ring this plane computes until the drain completes.
+        self._revoked: set = set()
+
+    # -- revocation override ---------------------------------------------------
+
+    def set_revoked(self, ranks) -> None:
+        """Install the revocation override: ``ranks`` are doomed hosts that
+        must not HOLD replicas (they may still own shards — that data is
+        what ``evacuate`` copies off). Pass an empty iterable to clear."""
+        self._revoked = {int(r) for r in (ranks or ())}
+
+    def evacuate(self, state: Any, step: int, world: int) -> Optional[Dict]:
+        """Re-push the revoked ranks' shards under the exclusion override,
+        landing their ZeRO slices on surviving hosts specifically — the
+        drain step of an advance-notice revocation. No-op (None) when no
+        revoked rank is in range."""
+        doomed = sorted(r for r in self._revoked if 0 <= r < world)
+        if not doomed:
+            return None
+        return self._replicate_ranks(state, step, doomed, world)
 
     # -- placement lifecycle ---------------------------------------------------
 
@@ -155,7 +176,8 @@ class CkptPlane:
         invalidate the previous epoch's. Idempotent and best-effort."""
         try:
             publish_placement(self.client, epoch, world, self.replicas,
-                              prev_epoch=self._published_epoch)
+                              prev_epoch=self._published_epoch,
+                              exclude=sorted(self._revoked))
             self._published_epoch = int(epoch)
         except Exception:  # edl: noqa[EDL005] placement publish is advisory metadata; losing it degrades to manifest-derived discovery, never to data loss
             log.debug("ckpt-plane placement publish failed", exc_info=True)
@@ -190,7 +212,8 @@ class CkptPlane:
                 blob = serialize_shard(leaves, step, rank, world)
                 chunks = chunk_blob(blob, self.chunk_bytes)
                 group = [owner_key(h, self.owner_prefix)
-                         for h in replica_group(rank, world, self.replicas)]
+                         for h in replica_group(rank, world, self.replicas,
+                                                exclude=self._revoked)]
                 self._put_chunks(owner_key(rank, self.owner_prefix), step,
                                  chunks, len(blob), group)
                 total += len(blob)

@@ -272,6 +272,12 @@ def cmd_status(args) -> int:
         for item in holders:
             worker, _, count = str(item).rpartition("=")
             print(f"    {worker:<24} {count}")
+    preempts = status.get("preempts") or []
+    if preempts:
+        print("  pending revocations:")
+        for item in preempts:
+            worker, _, notice = str(item).rpartition("=")
+            print(f"    {worker:<24} notice={notice}s")
     if policies:
         print("  fault-tolerance policy:")
         for worker, st in sorted(policies.items()):

@@ -691,6 +691,17 @@ class CoordinatorClient:
         waiting for a membership event. Returns the new epoch."""
         return int(self.call("bump_epoch")["epoch"])
 
+    def preempt_notice(self, targets: List[str], notice_s: float = 30.0,
+                       reason: str = "preempt") -> List[str]:
+        """Schedule an advance-notice revocation: each target worker gets a
+        ``{"notify": "preempt", ...}`` frame pushed on its watch stream (or
+        replayed when it next subscribes) and ``notice_s`` seconds to drain.
+        The notice is volatile scheduler state — a coordinator restart
+        forgets it and the scheduler re-issues. Returns the revoked names."""
+        return list(self.call("preempt_notice", targets=list(targets),
+                              notice_s=float(notice_s),
+                              reason=reason).get("revoked", []))
+
     # -- task queue ------------------------------------------------------------
 
     def add_tasks(self, tasks: List[str]) -> int:

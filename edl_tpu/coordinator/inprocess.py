@@ -72,6 +72,12 @@ class InProcessCoordinator:
         # fd-keyed): pending notification frames per subscriber, drained by
         # the shim's watch take path the way the wire server pushes them.
         self._watch_queues: Dict[str, deque] = {}
+        # Pending advance-notice revocations (native parity: preempts_),
+        # worker -> {notice_s, reason, seq}. Volatile by design — a
+        # restarted coordinator forgets notices and the scheduler re-issues
+        # them; consumed when the worker actually departs (_drop_member).
+        self._preempts: Dict[str, Dict] = {}
+        self._preempt_seq = 0
         # Test-only mutation hook: EDL009's model checker flips this on a
         # deliberately-broken twin to prove a dedup regression is caught.
         # Never set outside tests.
@@ -331,6 +337,9 @@ class InProcessCoordinator:
         self._notify_watchers()
         self._requeue_worker_leases(name)
         self._acquire_cache.pop(name, None)
+        # The departure a notice predicted has happened: the revocation is
+        # consumed (a re-registered successor under this name is fresh).
+        self._preempts.pop(name, None)
         self._release_sync()
 
     def _release_sync(self) -> None:
@@ -742,6 +751,39 @@ class InProcessCoordinator:
         for q in self._watch_queues.values():
             q.append(self._notify_frame(self._epoch))
 
+    def _preempt_frame(self, worker: str) -> Dict:
+        """Targeted revocation frame (native push_preempt): no wall clock —
+        the client anchors the drain deadline to its own monotonic arrival
+        time plus notice_s, so clock skew never shortens the budget."""
+        p = self._preempts[worker]
+        return {"ok": True, "notify": "preempt", "worker": worker,
+                "notice_s": p["notice_s"], "reason": p["reason"],
+                "seq": p["seq"], "epoch": self._epoch,
+                "cursor": self._epoch, "world": len(self._members)}
+
+    def preempt_notice(self, targets: List[str], notice_s: float = 0.0,
+                       reason: str = "") -> Dict:
+        """Advance-notice revocation (native op_preempt_notice): record the
+        pending notice per target and push a targeted frame to the target's
+        subscription. No membership change here — the drain the notice
+        triggers ends in leave/_drop_member like any departure."""
+        with self._lock:
+            self._tick()
+            if not isinstance(targets, list) or not targets:
+                return {"ok": False, "error": "targets array required"}
+            revoked: List[str] = []
+            for t in targets:
+                t = str(t)
+                self._preempt_seq += 1
+                self._preempts[t] = {"notice_s": float(notice_s),
+                                     "reason": reason or "preempt",
+                                     "seq": self._preempt_seq}
+                q = self._watch_queues.get(t)
+                if q is not None:
+                    q.append(self._preempt_frame(t))
+                revoked.append(t)
+            return {"ok": True, "revoked": revoked}
+
     def watch(self, worker: str, cursor: int = -1) -> Dict:
         """Subscribe ``worker`` to epoch-change notifications. cursor >= 0
         resumes after a reconnect: every epoch in (cursor, current] is
@@ -753,6 +795,10 @@ class InProcessCoordinator:
             if cursor >= 0:
                 for e in range(int(cursor) + 1, self._epoch + 1):
                     q.append(self._notify_frame(e))
+            # A notice posted before this subscription is replayed (native
+            # parity) — at-least-once delivery; clients dedup on seq.
+            if worker in self._preempts:
+                q.append(self._preempt_frame(worker))
             return {"ok": True, "watch": True, "cursor": self._epoch,
                     "epoch": self._epoch}
 
@@ -765,7 +811,18 @@ class InProcessCoordinator:
             if not q:
                 return {"ok": True, "notify": None, "cursor": self._epoch,
                         "world": len(self._members)}
-            return q.popleft()
+            frame = q.popleft()
+            if frame.get("notify") == "preempt":
+                # Rebuilt as a literal rather than aliased: takers must not
+                # be able to mutate queued history, and the wire-parity
+                # checker reads the reply vocabulary from this shape.
+                return {"ok": True, "notify": "preempt",
+                        "worker": frame["worker"],
+                        "notice_s": frame["notice_s"],
+                        "reason": frame["reason"], "seq": frame["seq"],
+                        "epoch": frame["epoch"], "cursor": frame["cursor"],
+                        "world": frame["world"]}
+            return frame
 
     def watch_cancel(self, worker: str) -> Dict:
         with self._lock:
@@ -847,6 +904,12 @@ class InProcessCoordinator:
                 # wire writer has no nested objects, so neither do we).
                 "lease_holders": sorted(
                     f"{w}={n}" for w, n in holders.items()
+                ),
+                # pending revocations, same flat encoding; notice_s is
+                # integer-truncated to match the native formatting.
+                "preempts": sorted(
+                    f"{w}={int(p['notice_s'])}"
+                    for w, p in self._preempts.items()
                 ),
             }
 
@@ -989,6 +1052,15 @@ class InProcessClient:
         self._auth()
         # int, matching CoordinatorClient.bump_epoch's unwrapped return.
         return int(self._c.bump_epoch()["epoch"])
+
+    def preempt_notice(self, targets, notice_s=30.0, reason="preempt"):
+        # list of revoked names, matching CoordinatorClient.preempt_notice's
+        # unwrapped return (the straggler detector and chaos scenarios call
+        # this surface generically across both transports).
+        self._auth()
+        return list(self.call("preempt_notice", targets=list(targets),
+                              notice_s=float(notice_s),
+                              reason=str(reason)).get("revoked", []))
 
     def kv_put(self, key, value):
         self._auth()
@@ -1138,6 +1210,11 @@ class InProcessClient:
                 timeout if timeout is not None else 60.0))
         if op == "bump_epoch":
             return self._c.bump_epoch()
+        if op == "preempt_notice":
+            return self._stamp(self._c.preempt_notice(
+                fields.get("targets"),
+                notice_s=float(fields.get("notice_s", 0) or 0),
+                reason=fields.get("reason", "")))
         if op == "status":
             return self._c.status()
         if op == "watch":

@@ -62,9 +62,16 @@ class EpochWatch:
         self.notifies_total = 0
         self.duplicates_dropped = 0
         self.resubscribes = 0
+        self.preempts_total = 0
         self._sock: Optional[socket.socket] = None
         self._buf = b""
         self._pending: List[Tuple[int, float]] = []
+        #: advance-notice revocations addressed to this worker. Delivery is
+        #: at-least-once (live push + replay-on-resubscribe), so dedup on
+        #: the server's issue seq; the deadline anchors to local monotonic
+        #: arrival + notice_s — frames carry no wall clock.
+        self._preempt_pending: List[Dict] = []
+        self._preempt_seq_seen = 0
         self._retry_at = 0.0
         self._retry_delay = self._RETRY_MIN
 
@@ -99,6 +106,9 @@ class EpochWatch:
                         f"{frame.get('error', 'unauthorized')}")
                 if frame.get("notify") == "epoch":
                     self._absorb(frame)
+                    continue
+                if frame.get("notify") == "preempt":
+                    self._absorb_preempt(frame)
                     continue
                 if frame.get("watch"):
                     break
@@ -171,7 +181,16 @@ class EpochWatch:
                 break
             if frame.get("notify") == "epoch":
                 self._absorb(frame)
+            elif frame.get("notify") == "preempt":
+                self._absorb_preempt(frame)
         return self._take_pending()
+
+    def take_preempts(self) -> List[Dict]:
+        """Drain revocation notices observed since the last call. Each dict
+        carries worker/notice_s/reason/seq plus ``arrival`` (monotonic) and
+        ``deadline`` (= arrival + notice_s) for budget math."""
+        out, self._preempt_pending = self._preempt_pending, []
+        return out
 
     # -- internals -------------------------------------------------------------
 
@@ -187,6 +206,23 @@ class EpochWatch:
             return
         self.last_epoch = epoch
         self._pending.append((epoch, time.monotonic()))
+
+    def _absorb_preempt(self, frame: Dict) -> None:
+        try:
+            seq = int(frame.get("seq", 0))
+            notice_s = float(frame.get("notice_s", 0))
+        except (TypeError, ValueError):
+            return
+        if seq <= self._preempt_seq_seen:
+            self.duplicates_dropped += 1
+            return
+        self._preempt_seq_seen = seq
+        self.preempts_total += 1
+        now = time.monotonic()
+        self._preempt_pending.append({
+            "worker": frame.get("worker", ""), "notice_s": notice_s,
+            "reason": frame.get("reason", "preempt"), "seq": seq,
+            "arrival": now, "deadline": now + notice_s})
 
     def _take_pending(self) -> List[Tuple[int, float]]:
         out, self._pending = self._pending, []
@@ -254,6 +290,9 @@ class InProcessEpochWatch:
         self.notifies_total = 0
         self.duplicates_dropped = 0
         self.resubscribes = 0
+        self.preempts_total = 0
+        self._preempt_pending: List[Dict] = []
+        self._preempt_seq_seen = 0
 
     def subscribe(self, timeout: float = 5.0) -> bool:
         try:
@@ -276,6 +315,24 @@ class InProcessEpochWatch:
             except Exception:  # edl: noqa[EDL005] same degrade-to-pull contract as subscribe(): the caller's pull path owns liveness
                 self.connected = False
                 break
+            if frame.get("notify") == "preempt":
+                try:
+                    seq = int(frame.get("seq", 0))
+                    notice_s = float(frame.get("notice_s", 0))
+                except (TypeError, ValueError):
+                    continue
+                if seq <= self._preempt_seq_seen:
+                    self.duplicates_dropped += 1
+                    continue
+                self._preempt_seq_seen = seq
+                self.preempts_total += 1
+                now = time.monotonic()
+                self._preempt_pending.append({
+                    "worker": frame.get("worker", ""),
+                    "notice_s": notice_s,
+                    "reason": frame.get("reason", "preempt"), "seq": seq,
+                    "arrival": now, "deadline": now + notice_s})
+                continue
             if frame.get("notify") != "epoch":
                 break
             self.notifies_total += 1
@@ -288,6 +345,11 @@ class InProcessEpochWatch:
                 continue
             self.last_epoch = epoch
             out.append((epoch, time.monotonic()))
+        return out
+
+    def take_preempts(self) -> List[Dict]:
+        """Same contract as `EpochWatch.take_preempts`."""
+        out, self._preempt_pending = self._preempt_pending, []
         return out
 
     def close(self) -> None:

@@ -15,13 +15,19 @@ from typing import Optional
 from edl_tpu.obs.metrics import MetricsRegistry, get_registry
 
 __all__ = ["WorkerInstruments", "FTPolicyInstruments", "ServeInstruments",
-           "CkptPlaneInstruments", "OUTAGE_BUCKETS", "SERVE_LATENCY_BUCKETS"]
+           "CkptPlaneInstruments", "PreemptInstruments", "OUTAGE_BUCKETS",
+           "SERVE_LATENCY_BUCKETS", "NOTICE_BUCKETS"]
 
 #: outage-duration buckets: sub-second blips through multi-minute storms.
 #: The default latency buckets top out at 60 s — exactly where the park
 #: decision gets interesting — so outages get their own scale.
 OUTAGE_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
                   120.0, 300.0, 600.0)
+
+#: notice-to-drained buckets: spot notices run 25-120 s, and a healthy
+#: drain (evacuate + replan + shrink) should finish in single-digit
+#: seconds — the interesting resolution is "how much notice was left".
+NOTICE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0, 60.0, 120.0)
 
 #: request-latency buckets: the serving SLO lives in the 1 ms - 1 s band
 #: (queue wait + pad + device step), far below the default latency
@@ -239,6 +245,61 @@ class CkptPlaneInstruments:
             "edl_ckpt_plane_restore_bytes_total",
             "restore bytes served, by source (peer vs blob)",
             labelnames=("source",),
+        )
+
+
+class PreemptInstruments:
+    """The preemption plane's sensor suite: advance-notice revocations and
+    the straggler detector that feeds the same drain path. One scrape
+    answers "did we beat the deadline, and what did it cost?"."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.notices = r.counter(
+            "edl_preempt_notices_total",
+            "advance-notice revocation frames consumed, by reason "
+            "(spot/maintenance/straggler/...)",
+            labelnames=("reason",),
+        )
+        self.notice_remaining = r.gauge(
+            "edl_preempt_notice_remaining_seconds",
+            "seconds left on the most recently consumed notice when the "
+            "policy decided (negative = decided after the deadline)",
+        )
+        self.notice_to_drained = r.histogram(
+            "edl_preempt_notice_to_drained_seconds",
+            "notice-arrival to drain-complete latency per revocation "
+            "(evacuate + replan + shrink; must sit under the notice window)",
+            buckets=NOTICE_BUCKETS,
+        )
+        self.evictions = r.counter(
+            "edl_preempt_evictions_total",
+            "workers drained out through the revocation path, by trigger "
+            "(revocation = scheduler notice, straggler = slow-host evict)",
+            labelnames=("trigger",),
+        )
+        self.steps_lost = r.counter(
+            "edl_preempt_steps_lost_total",
+            "optimizer steps re-trained because a revocation beat the "
+            "drain (0 is the contract for any notice >= the drain cost)",
+        )
+        self.straggler_ratio = r.gauge(
+            "edl_straggler_step_ratio",
+            "trailing-window per-host step-time quantile over the fleet "
+            "median (1.0 = keeping pace; the eviction trigger compares "
+            "this against its threshold for consecutive windows)",
+            labelnames=("host",),
+        )
+        self.straggler_breaches = r.counter(
+            "edl_straggler_breaches_total",
+            "windows in which a host's step-time quantile breached the "
+            "eviction threshold (hysteresis counts these, not raw steps)",
+            labelnames=("host",),
+        )
+        self.straggler_evictions = r.counter(
+            "edl_straggler_evictions_total",
+            "hosts evicted by the straggler detector (always also counted "
+            "in edl_preempt_evictions_total{trigger=straggler})",
         )
 
 

@@ -39,11 +39,14 @@ __all__ = [
 
 #: The rescale lifecycle's phase vocabulary, in causal order. The e2e test
 #: and the bench assert all of these appear under one rescale trace id.
+#: ``preempt_drain`` is the advance-notice window (notice arrival through
+#: doomed-rank shard evacuation — degenerate-but-present on rescales no
+#: notice triggered, keeping the completeness gate unconditional),
 #: ``replan`` is the layout search (planner argmin over candidate meshes —
 #: degenerate-but-present on data-only resizes) and ``reshard`` is the
 #: device_put window that moves restored state onto the new mesh layout.
-RESCALE_PHASES = ("drain", "checkpoint", "replan", "warm_compile", "restore",
-                  "reshard", "first_step")
+RESCALE_PHASES = ("preempt_drain", "drain", "checkpoint", "replan",
+                  "warm_compile", "restore", "reshard", "first_step")
 
 
 def rescale_trace_id(epoch: int) -> str:

@@ -260,10 +260,17 @@ class LeaseReader:
         stop_check: Optional[Callable[[], bool]] = None,
         defer_completion: bool = False,
         prefetch: bool = False,
+        soft_stop_check: Optional[Callable[[], bool]] = None,
     ):
         self.client = client
         self.source = source
         self.stop_check = stop_check or (lambda: False)
+        #: polled at shard BOUNDARIES only: a soft stop finishes (and
+        #: completes) the in-flight shard, then stops leasing — the
+        #: replay-free drain an advance-notice revocation takes when its
+        #: budget affords it, vs. stop_check's mid-shard interrupt that
+        #: fails the lease back for replay.
+        self.soft_stop_check = soft_stop_check or (lambda: False)
         self.defer_completion = defer_completion
         self.prefetch = prefetch
         self.completed: List[str] = []
@@ -277,6 +284,9 @@ class LeaseReader:
         #: metrics attribution; see ``split_pass``).
         self.current: Optional[str] = None
         self.interrupted: Optional[str] = None
+        #: a soft stop fired: the reader stopped at a shard boundary with
+        #: nothing failed back (no replay pending anywhere).
+        self.drained = False
         self.exhausted = False
 
     def take_consumed(self) -> List[str]:
@@ -307,6 +317,9 @@ class LeaseReader:
 
     def _iter_sync(self) -> Iterator[Dict[str, np.ndarray]]:
         while True:
+            if self.soft_stop_check():
+                self.drained = True
+                return
             reply = self.client.acquire()
             task = reply.get("task")
             if task is None:
@@ -346,8 +359,14 @@ class LeaseReader:
             return
         fut = load(cur)
         while cur is not None:
-            nxt = self.client.acquire().get("task")  # overlaps cur's training
-            nfut = load(nxt) if nxt is not None else None
+            if self.soft_stop_check():
+                # Boundary drain under the pipelined loop: stop leasing
+                # ahead — cur (possibly last round's look-ahead, already
+                # leased + loaded) still trains to completion.
+                nxt, nfut = None, None
+            else:
+                nxt = self.client.acquire().get("task")  # overlaps training
+                nfut = load(nxt) if nxt is not None else None
             self.current = cur
             for batch in fut.result():
                 if self.stop_check():
@@ -361,6 +380,9 @@ class LeaseReader:
                 yield batch
             self._finish(cur)
             cur, fut = nxt, nfut
+        if self.soft_stop_check():
+            self.drained = True
+            return
         # The pipeline's look-ahead acquire saw an empty queue one shard ago;
         # re-check now that the final shard completed. A task appearing here
         # (late requeue) goes back to the queue — the caller's outer loop

@@ -37,13 +37,15 @@ from jax.sharding import Mesh
 from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.coordinator.watch import make_epoch_watch
 from edl_tpu.models.base import Model
-from edl_tpu.obs.instruments import WorkerInstruments
+from edl_tpu.obs.instruments import PreemptInstruments, WorkerInstruments
 from edl_tpu.obs.tracing import Tracer, get_tracer, rescale_trace_id
 from edl_tpu.parallel.mesh import MeshSpec, build_hierarchical_mesh, build_mesh
 from edl_tpu.parallel.planner import Plan
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.data import LeaseReader, split_pass
-from edl_tpu.runtime.ft_policy import PARK, FTPolicy, FTPolicyConfig
+from edl_tpu.runtime.ft_policy import (
+    DRAIN_SHRINK, MODE_CODES, PARK, RIDE_OUT, FTPolicy, FTPolicyConfig,
+)
 from edl_tpu.runtime.train_loop import Trainer, TrainerConfig, TrainState
 from edl_tpu.runtime.wire import WireRestartRequired
 
@@ -356,6 +358,16 @@ class ElasticWorker:
         #: span's start (signal -> step loop quiesced), 0.0 when no signal
         #: is pending.
         self._drain_signal_t = 0.0
+        #: preemption sensor suite (notices, notice-to-drained, evictions).
+        self.preempt_obs = PreemptInstruments()
+        #: advance-notice revocation addressed to THIS worker, consumed
+        #: from the watch stream and awaiting its drain: the notice dict
+        #: (worker/notice_s/reason/seq/arrival/deadline) plus the policy's
+        #: ``mode`` and the wall-clock arrival for span stitching.
+        self._pending_preempt: Optional[Dict] = None
+        #: replay-free drain latch: the reader stops at the next shard
+        #: BOUNDARY (nothing fails back) instead of interrupting mid-shard.
+        self._soft_drain = False
         #: times the worker hit the outage budget and parked.
         self.parks = 0
         #: completion lag (at-least-once across hard crashes): shards whose
@@ -509,7 +521,120 @@ class ElasticWorker:
             self.obs.note_epoch_notify(now - arrived)
             if epoch > self._epoch:
                 moved = True
+        take = getattr(self._watch, "take_preempts", None)
+        if callable(take):
+            for notice in take():
+                if self._handle_preempt(notice):
+                    moved = True
         return moved
+
+    def _handle_preempt(self, notice: Dict) -> bool:
+        """One revocation notice addressed to this worker: run the policy's
+        notice-budget decision and report whether the step loop should
+        interrupt mid-shard. ``ride_out`` keeps stepping — the notice was
+        too short for even a checkpoint to pay off. ``drain_shrink`` (ample
+        budget) drains at the next SHARD boundary via the soft latch:
+        the in-flight shard finishes and completes, so NOTHING replays on
+        the survivors. ``park`` (tight budget) interrupts mid-shard — the
+        in-flight lease fails back (at-least-once replay accepted) to buy
+        checkpoint time before the deadline."""
+        now_mono = time.monotonic()
+        remaining = notice["deadline"] - now_mono
+        self.preempt_obs.notices.inc(reason=notice.get("reason", "preempt"))
+        self.preempt_obs.notice_remaining.set(remaining)
+        mode = self.policy.on_preempt_notice(remaining)
+        log.warning(
+            "preempt notice: %.1fs remaining (reason=%s seq=%s) -> %s",
+            remaining, notice.get("reason"), notice.get("seq"), mode)
+        if mode == RIDE_OUT:
+            return False
+        self._pending_preempt = {
+            **notice, "mode": mode,
+            # monotonic arrival -> wall clock, so the preempt_drain span
+            # stitches onto the survivors' rescale timeline.
+            "wall_arrival": time.time() - (now_mono - notice["arrival"]),
+        }
+        if mode == DRAIN_SHRINK:
+            self._soft_drain = True
+            self._signal_drain()  # drain span starts at the decision
+            return False
+        return True
+
+    def _finish_preempt_drain(self, state: TrainState, drain_t0: float,
+                              ck_t0: float, ck_t1: float, world: int,
+                              t_start: float) -> Dict[str, float]:
+        """The revoked worker's exit: evacuate this rank's shards onto
+        surviving replica holders, leave (bumping the epoch the survivors
+        replan under), and return a summary with ``steps_lost == 0`` — the
+        blocking checkpoint that preceded this call made every consumed
+        shard durable, so nothing trained here replays.
+
+        The ``preempt_drain`` span (notice arrival -> evacuation done) is
+        stamped with the POST-leave epoch's trace id: that is the rescale
+        the survivors run, so their drain/replan/restore spans and our
+        notice-window span stitch into one timeline.
+        """
+        pd = self._pending_preempt
+        self._pending_preempt = None
+        self._soft_drain = False
+        assert pd is not None
+        ev_t0 = time.time()
+        if self.ckpt_plane is not None and pd["mode"] == DRAIN_SHRINK:
+            # Placement override: this rank is banned from every replica
+            # ring from here on, and its shards are pushed to survivors NOW
+            # (peer-sourced restore must not depend on the doomed host).
+            self.ckpt_plane.set_revoked([self._rank])
+            self.ckpt_plane.evacuate(state, int(state.step),
+                                     max(1, self._world))
+        reply = self.client.leave()
+        drained_mono = time.monotonic()
+        ev_t1 = time.time()
+        left_epoch = int(reply.get("epoch", self._epoch + 1))
+        rid = rescale_trace_id(left_epoch)
+        self.tracer.record("preempt_drain", pd["wall_arrival"], ev_t1,
+                           trace_id=rid, component="worker", notice=True,
+                           mode=pd["mode"], reason=pd.get("reason", ""),
+                           notice_s=float(pd.get("notice_s", 0.0)),
+                           evacuate_seconds=round(ev_t1 - ev_t0, 6))
+        self.tracer.record("drain", drain_t0, ck_t0, trace_id=rid,
+                           component="worker", from_world=world)
+        self.tracer.record("checkpoint", ck_t0, ck_t1, trace_id=rid,
+                           component="worker")
+        notice_to_drained = drained_mono - pd["arrival"]
+        deadline_met = drained_mono <= pd["deadline"]
+        self.preempt_obs.notice_to_drained.observe(notice_to_drained)
+        trigger = ("straggler" if pd.get("reason") == "straggler"
+                   else "revocation")
+        self.preempt_obs.evictions.inc(trigger=trigger)
+        log.warning(
+            "preempt drain complete: left epoch %d after %.2fs of %.1fs "
+            "notice (deadline %s, trigger=%s, steps_lost=0)",
+            left_epoch, notice_to_drained, float(pd.get("notice_s", 0.0)),
+            "met" if deadline_met else "MISSED", trigger)
+        outage = {f"outage_{k}": v for k, v in self.client.summary().items()}
+        outage["outage_parks"] = float(self.parks)
+        outage.update({f"policy_{m}": float(n)
+                       for m, n in self.policy.decisions.items()})
+        outage["policy_incidents"] = float(self.policy.incidents)
+        return {
+            **outage,
+            "steps": float(self.steps_done),
+            "final_loss": self.losses[-1] if self.losses else float("nan"),
+            "world": float(world),
+            "passes_trained": float(len(self.pass_steps)),
+            "rescales": float(len(self.rescales)),
+            "max_recovery_seconds": max(
+                (r.recovery_seconds for r in self.rescales), default=0.0),
+            "seconds": time.perf_counter() - t_start,
+            "preempted": 1.0,
+            "preempt_mode_code": float(MODE_CODES[pd["mode"]]),
+            "preempt_notice_s": float(pd.get("notice_s", 0.0)),
+            "notice_to_drained_seconds": round(notice_to_drained, 6),
+            "preempt_deadline_met": 1.0 if deadline_met else 0.0,
+            # Every consumed shard was committed by the blocking checkpoint
+            # above; the evacuated shards restore peer-side. Nothing replays.
+            "steps_lost": 0.0,
+        }
 
     def _epoch_changed(self, force: bool = False) -> bool:
         """Heartbeat (rate-limited) and report whether membership moved.
@@ -921,6 +1046,13 @@ class ElasticWorker:
             if pending_drain is not None:
                 drain_t0, ck_t0, ck_t1 = pending_drain
                 pending_drain = None
+                # No notice triggered THIS worker's drain: the zero-length
+                # marker keeps the 8-phase completeness gate unconditional
+                # (a revoked peer's real preempt_drain span lands on the
+                # same trace id from its side of the drain).
+                self.tracer.record("preempt_drain", drain_t0, drain_t0,
+                                   trace_id=rid, component="worker",
+                                   notice=False)
                 self.tracer.record("drain", drain_t0, ck_t0, trace_id=rid,
                                    component="worker",
                                    from_world=self._prev_world)
@@ -1017,6 +1149,7 @@ class ElasticWorker:
                     stop_check=self._epoch_changed,
                     defer_completion=True,
                     prefetch=self.config.prefetch,
+                    soft_stop_check=lambda: self._soft_drain,
                 )
                 if self.profiler is not None:
                     self.profiler.start()
@@ -1096,6 +1229,13 @@ class ElasticWorker:
                     # winding the reader down.
                     drain_t0 = self._drain_signal_t or time.time()
                     self._drain_signal_t = 0.0
+                elif reader.drained:
+                    # Replay-free boundary drain (advance-notice revocation
+                    # with budget): the in-flight shard completed, nothing
+                    # failed back.
+                    rescale = True
+                    drain_t0 = self._drain_signal_t or time.time()
+                    self._drain_signal_t = 0.0
                 elif reader.exhausted:
                     finished = True
                 else:
@@ -1121,6 +1261,12 @@ class ElasticWorker:
                 self._checkpoint_and_commit(state, None, block=True)
                 ck_t1 = time.time()
                 pending_drain = (drain_t0, ck_t0, ck_t1)
+                if self._pending_preempt is not None:
+                    # This worker is the one being revoked: finish the
+                    # drain (evacuate, leave) and exit — the survivors
+                    # replan and shrink under the epoch our leave bumps.
+                    return self._finish_preempt_drain(
+                        state, drain_t0, ck_t0, ck_t1, world, t_start)
                 if self.config.restart_on_rescale:
                     from edl_tpu.launcher.launch import RESCALE_EXIT_CODE
 

@@ -79,6 +79,8 @@ __all__ = [
     "WARM_RESTART",
     "PARK",
     "PEER_RESTORE",
+    "DRAIN_SHRINK",
+    "RIDE_OUT",
     "MODE_CODES",
     "FTPolicyConfig",
     "FTPolicy",
@@ -93,11 +95,17 @@ PARK = "park"
 #: ZeRO shards) instead of the blob store — not an escalation rung but a
 #: restore-source decision, recorded with the same audit machinery.
 PEER_RESTORE = "peer_restore"
+#: advance-notice revocation outcomes (the notice-budget decision): enough
+#: notice to evacuate shards + replan + shrink before the deadline...
+DRAIN_SHRINK = "drain_shrink"
+#: ...or so little that the cheapest move is to keep stepping and let the
+#: surprise-failure machinery (peer replicas, requeued leases) absorb it.
+RIDE_OUT = "ride_out"
 
 #: numeric encoding for the ``edl_ft_policy_mode`` gauge (Prometheus
 #: gauges carry floats; the mapping is part of the metric's contract).
 MODE_CODES: Dict[str, int] = {WAIT: 0, RECONNECT: 1, WARM_RESTART: 2, PARK: 3,
-                              PEER_RESTORE: 4}
+                              PEER_RESTORE: 4, DRAIN_SHRINK: 5, RIDE_OUT: 6}
 
 
 @dataclass
@@ -137,6 +145,10 @@ class FTPolicyConfig:
     history_size: int = 64
     #: EMA smoothing for the step/checkpoint/restore cost estimates.
     cost_alpha: float = 0.3
+    #: safety divisor on an advance-notice budget: a drain is attempted
+    #: only when the remaining notice covers its predicted cost with this
+    #: much headroom (clock skew, straggling evacuation chunks).
+    notice_margin: float = 1.25
 
     def __post_init__(self) -> None:
         if self.policy not in ("adaptive", "static"):
@@ -202,6 +214,7 @@ class FTPolicy:
         self._ckpt_ema = 0.0
         self._restore_ema = 0.0
         self._peer_restore_ema = 0.0
+        self._replan_ema = 0.0
         self._steps_since_ckpt = 0
         # -- incident state (the hysteresis core) --
         #: threshold frozen at incident open; None while healthy.
@@ -229,6 +242,11 @@ class FTPolicy:
     def note_restore_cost(self, seconds: float) -> None:
         self._restore_ema = self._ema(self._restore_ema, max(0.0, seconds))
         self.obs.restore_cost.set(self._restore_ema, source="blob")
+
+    def note_replan_cost(self, seconds: float) -> None:
+        """Layout-replanner solve + relayout time: one input of the
+        notice-budget drain decision."""
+        self._replan_ema = self._ema(self._replan_ema, max(0.0, seconds))
 
     def note_peer_restore(self, seconds: float) -> None:
         """A restore was served from the checkpoint plane: feed its cost EMA
@@ -317,6 +335,44 @@ class FTPolicy:
         )
         return min(cfg.outage_budget, max(cfg.min_wait, want))
 
+    def drain_cost(self) -> float:
+        """Predicted seconds a drain-and-shrink takes: evacuate the doomed
+        ranks' shards (priced as one checkpoint pass), re-solve the mesh,
+        restore on the survivors. Unmeasured terms price as 0 — cold start
+        is optimistic by design (attempting a drain that overruns degrades
+        to exactly what riding it out would have cost)."""
+        return (self._ckpt_ema + self._replan_ema
+                + self.effective_restore_cost())
+
+    def on_preempt_notice(self, notice_remaining_s: float) -> str:
+        """The notice-budget decision: with ``notice_remaining_s`` seconds
+        until revocation, pick the cheapest exit.
+
+        - ``drain_shrink`` when the margin-discounted budget covers the
+          full measured drain cost (evacuate + replan + restore): the job
+          shrinks onto the survivors with zero lost steps.
+        - ``park`` when the budget covers at least a durable checkpoint:
+          save and park, resume when replacement capacity shows up.
+        - ``ride_out`` when the notice is shorter than even a checkpoint:
+          spending it on a doomed save is pure loss — keep stepping and let
+          the surprise-failure machinery absorb the kill.
+
+        Stateless with respect to the outage machinery: a revocation is not
+        an outage (the coordinator is healthy), so no incident opens and no
+        hysteresis latch applies — each notice decides fresh."""
+        budget = max(0.0, notice_remaining_s) / max(
+            1.0, self.config.notice_margin)
+        if budget >= self.drain_cost():
+            mode = DRAIN_SHRINK
+        elif self._ckpt_ema > 0.0 and budget >= self._ckpt_ema:
+            mode = PARK
+        else:
+            mode = RIDE_OUT
+        self._decide(mode, notice_remaining_s,
+                     notice_remaining_s=round(notice_remaining_s, 6),
+                     drain_cost=round(self.drain_cost(), 6))
+        return mode
+
     def on_outage(self, elapsed: float, escalate_mode: str = PARK) -> str:
         """One degraded-mode poll: ``elapsed`` seconds into the current
         outage, decide ``wait`` or ``escalate_mode``.
@@ -361,7 +417,7 @@ class FTPolicy:
             self._decide(RECONNECT, duration)
         self._publish_inputs()
 
-    def _decide(self, mode: str, elapsed: float) -> None:
+    def _decide(self, mode: str, elapsed: float, **extra) -> None:
         self.decisions[mode] += 1
         self._last_mode = mode
         self.obs.decisions.inc(mode=mode)
@@ -381,6 +437,7 @@ class FTPolicy:
             failure_rate_per_min=round(self.failure_rate_per_min(), 4),
             incidents=self.incidents,
             history=len(self.history),
+            **extra,
         )
 
     def _publish_inputs(self) -> None:
@@ -426,6 +483,8 @@ class FTPolicy:
             "restore_source": self.restore_source(),
             "restore_cost_blob": round(self._restore_ema, 3),
             "restore_cost_peer": round(self._peer_restore_ema, 3),
+            "replan_cost": round(self._replan_ema, 3),
+            "drain_cost": round(self.drain_cost(), 3),
             "failure_rate_per_min": round(self.failure_rate_per_min(), 3),
             "storm": self.in_storm(),
             "history": len(self.history),

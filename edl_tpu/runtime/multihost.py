@@ -51,11 +51,13 @@ from edl_tpu.coordinator.client import CoordinatorAuthError, CoordinatorError
 from edl_tpu.coordinator.outbox import OutboxClient
 from edl_tpu.coordinator.watch import make_epoch_watch
 from edl_tpu.models.base import Model
-from edl_tpu.obs.instruments import WorkerInstruments
+from edl_tpu.obs.instruments import PreemptInstruments, WorkerInstruments
 from edl_tpu.parallel import MeshSpec, build_hierarchical_mesh, build_mesh
 from edl_tpu.runtime.checkpoint import Checkpointer, abstract_like, live_state_specs
 from edl_tpu.runtime.elastic import ElasticConfig
-from edl_tpu.runtime.ft_policy import WARM_RESTART, FTPolicy, FTPolicyConfig
+from edl_tpu.runtime.ft_policy import (
+    RIDE_OUT, WARM_RESTART, FTPolicy, FTPolicyConfig,
+)
 from edl_tpu.runtime.train_loop import Trainer, TrainState
 
 log = logging.getLogger("edl_tpu.runtime.multihost")
@@ -191,6 +193,12 @@ class MultiHostWorker:
                 "a wire endpoint nor a call surface to subscribe on")
         self._epoch = -1
         self._watch_moved = False
+        #: advance-notice revocation (spot reclaim / straggler eviction):
+        #: a pushed preempt frame latches here and is consumed at the next
+        #: round boundary — same rule as epoch moves, a lockstep gang
+        #: cannot abandon a collective mid-flight.
+        self.preempt_obs = PreemptInstruments()
+        self._preempt_notice: Optional[Dict] = None
         #: dedicated pull rounds skipped because a healthy watch already
         #: covered epoch discovery (mirrors the metric family).
         self.pulls_suppressed = 0
@@ -254,7 +262,28 @@ class MultiHostWorker:
             self.obs.note_epoch_notify(now - arrived)
             if ep > self._epoch:
                 self._watch_moved = True
+        take = getattr(self._watch, "take_preempts", None)
+        if callable(take):
+            for notice in take():
+                self._handle_preempt(notice)
         return self._watch_moved
+
+    def _handle_preempt(self, notice: Dict) -> None:
+        """Run the notice-budget decision and latch non-ride-out verdicts
+        for the next round boundary. The latch keeps the EARLIEST deadline
+        if notices stack (a re-pushed notice never extends the first)."""
+        remaining = notice["deadline"] - time.monotonic()
+        self.preempt_obs.notices.inc(reason=notice.get("reason", "preempt"))
+        self.preempt_obs.notice_remaining.set(remaining)
+        mode = self.policy.on_preempt_notice(remaining)
+        log.warning(
+            "preempt notice: %.1fs remaining (reason=%s seq=%s) -> %s",
+            remaining, notice.get("reason"), notice.get("seq"), mode)
+        if mode == RIDE_OUT:
+            return
+        if self._preempt_notice is None or \
+                notice["deadline"] < self._preempt_notice["deadline"]:
+            self._preempt_notice = {**notice, "mode": mode}
 
     def _build_mesh(self) -> Mesh:
         devices = jax.devices()  # global: every process's chips
@@ -542,7 +571,7 @@ class MultiHostWorker:
         The reference's analog is free: trainer death just stops gradient
         pushes and the master re-leases its tasks; an SPMD gang must leave
         at a round boundary so no peer is abandoned mid-collective."""
-        log.info("SIGTERM drain: requeueing %d uncovered shards, leaving",
+        log.info("drain: requeueing %d uncovered shards, leaving",
                  len(self._uncommitted))
         consecutive_failures = 0
         for task in self._uncommitted:
@@ -564,6 +593,39 @@ class MultiHostWorker:
         except Exception:  # edl: noqa[EDL005] best-effort leave inside the SIGTERM grace window; membership TTL expires us anyway
             pass
         raise SystemExit(0)
+
+    def _preempt_leave(self, state: TrainState, rank: int,
+                       world: int) -> None:
+        """The revoked rank's round-boundary exit. One process of an SPMD
+        gang cannot checkpoint collectively alone, so the drain here is:
+        evacuate this rank's ZeRO slice onto surviving replica holders
+        (per-rank push, no collective), requeue the uncovered shards for
+        replay, and leave — `_graceful_leave`, the identical SIGTERM path.
+        Requeued shards ARE the steps-lost accounting (at-least-once: they
+        retrain on survivors)."""
+        pd = self._preempt_notice
+        self._preempt_notice = None
+        assert pd is not None
+        if self.ckpt_plane is not None:
+            # Placement override first: this rank never again appears in a
+            # replica ring, and its slice lands on survivors NOW.
+            self.ckpt_plane.set_revoked([rank])
+            self.ckpt_plane.evacuate(state, int(state.step), world)
+        drained_mono = time.monotonic()
+        notice_to_drained = drained_mono - pd["arrival"]
+        self.preempt_obs.notice_to_drained.observe(notice_to_drained)
+        trigger = ("straggler" if pd.get("reason") == "straggler"
+                   else "revocation")
+        self.preempt_obs.evictions.inc(trigger=trigger)
+        if self._uncommitted:
+            self.preempt_obs.steps_lost.inc(len(self._uncommitted))
+        log.warning(
+            "preempt drain at round boundary: %.2fs of %.1fs notice used "
+            "(deadline %s, trigger=%s, %d shards requeue)",
+            notice_to_drained, float(pd.get("notice_s", 0.0)),
+            "met" if drained_mono <= pd["deadline"] else "MISSED",
+            trigger, len(self._uncommitted))
+        self._graceful_leave()
 
     def run(self, max_rounds: int = 1_000_000) -> Dict[str, float]:
         import signal
@@ -662,6 +724,10 @@ class MultiHostWorker:
                 # Round boundary: no collective in flight on any peer that
                 # this rank could abandon — safe to go.
                 self._graceful_leave()
+            if self._preempt_notice is not None:
+                # Advance-notice revocation: same round-boundary exit as
+                # SIGTERM, plus shard evacuation while the notice lasts.
+                self._preempt_leave(state, rank, world)
             if rank == 0:
                 msg = self._publish_round(epoch, rnd, world)
             else:

@@ -1,5 +1,7 @@
 """Fault-injection helpers for resilience tests (not shipped runtime code)."""
 
-from edl_tpu.testing.chaosproxy import ChaosProxy
+from edl_tpu.testing.chaosproxy import (
+    ChaosProxy, ChaosScenario, StepSlowShim,
+)
 
-__all__ = ["ChaosProxy"]
+__all__ = ["ChaosProxy", "ChaosScenario", "StepSlowShim"]

@@ -35,7 +35,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger("edl_tpu.testing.chaosproxy")
 
-__all__ = ["ChaosProxy", "ScenarioStep", "ChaosScenario"]
+__all__ = ["ChaosProxy", "ScenarioStep", "ChaosScenario", "StepSlowShim"]
 
 
 def _hard_close(sock: socket.socket) -> None:
@@ -260,6 +260,57 @@ class ChaosProxy:
                     self._conns.remove(pair)
 
 
+class StepSlowShim:
+    """Per-step sleep shim: the straggler injector.
+
+    Installed as a step hook (``ElasticConfig.step_callback``, or called
+    once per step from any custom loop). With factor 1.0 it is a no-op;
+    :meth:`slow` makes every subsequent step take ~``factor`` x its
+    natural duration by sleeping the difference — the shim EMAs the
+    observed inter-step interval as its baseline, so the injected
+    slowness scales with the real workload instead of a hardcoded sleep
+    (the straggler detector must see a RATIO breach, and a fixed pause
+    under- or over-shoots depending on step time). Thread-safe: the
+    scenario driver flips ``factor`` while the step loop runs.
+    """
+
+    def __init__(self, alpha: float = 0.3, max_sleep: float = 5.0):
+        self.alpha = alpha
+        self.max_sleep = max_sleep
+        self.factor = 1.0
+        self.injected_steps = 0
+        self.injected_seconds = 0.0
+        self._ema = 0.0
+        self._last = 0.0
+        self._lock = threading.Lock()
+
+    def slow(self, factor: float = 2.0) -> None:
+        with self._lock:
+            self.factor = max(1.0, float(factor))
+
+    def restore(self) -> None:
+        self.slow(1.0)
+
+    def __call__(self, *_args, **_kwargs) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if self._last:
+                dt = now - self._last
+                self._ema = dt if self._ema == 0.0 else (
+                    self.alpha * dt + (1.0 - self.alpha) * self._ema)
+            self._last = now
+            factor, base = self.factor, self._ema
+        if factor > 1.0 and base > 0.0:
+            pause = min(self.max_sleep, (factor - 1.0) * base)
+            time.sleep(pause)
+            with self._lock:
+                self.injected_steps += 1
+                self.injected_seconds += pause
+                # Re-anchor so the injected pause never feeds the baseline
+                # EMA (the shim would otherwise compound itself).
+                self._last = time.monotonic()
+
+
 # -- scripted scenarios --------------------------------------------------------
 
 
@@ -343,6 +394,26 @@ class ChaosScenario:
         ``<name>.heal`` actions."""
         self._actions[f"{name}.partition"] = proxy.partition
         self._actions[f"{name}.heal"] = proxy.heal
+        return self
+
+    def register_coordinator(self, name: str, client) -> "ChaosScenario":
+        """Expose the advance-notice revocation trigger as
+        ``<name>.revoke``: a scripted step like
+        ``add("coord.revoke", worker="w0", notice_s=5.0)`` pushes the
+        doomed worker a preempt frame through the real control plane —
+        the scenario models the cloud scheduler, not a transport fault.
+        Kwargs ride the spec JSON, so revocation waves replay exactly."""
+        def _revoke(worker: str, notice_s: float = 30.0,
+                    reason: str = "preempt") -> None:
+            client.preempt_notice([worker], notice_s=notice_s, reason=reason)
+        self._actions[f"{name}.revoke"] = _revoke
+        return self
+
+    def register_slow(self, name: str, shim: StepSlowShim) -> "ChaosScenario":
+        """Expose a straggler shim as ``<name>.slow`` (kwargs: factor) and
+        ``<name>.restore`` — the slow-host half of the fault vocabulary."""
+        self._actions[f"{name}.slow"] = shim.slow
+        self._actions[f"{name}.restore"] = shim.restore
         return self
 
     def predicate(self, name: str, fn: Callable[[], bool]) -> "ChaosScenario":

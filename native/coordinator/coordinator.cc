@@ -301,6 +301,15 @@ struct Lease {
   double deadline = 0;
 };
 
+// A pending advance-notice revocation: the scheduler told us this worker's
+// capacity dies in notice_s seconds. seq dedups at-least-once frame delivery
+// across watch resubscribes (the role epoch plays for epoch frames).
+struct Preempt {
+  double notice_s = 0;
+  std::string reason;
+  long long seq = 0;
+};
+
 struct BarrierWaiter {
   int fd;
   std::string worker;
@@ -456,6 +465,7 @@ class Coordinator {
   std::string op_shard_meta(const JsonObject& req);
   std::string op_shard_drop(const JsonObject& req);
   std::string op_bump_epoch();
+  std::string op_preempt_notice(const JsonObject& req);
   std::string op_watch(const JsonObject& req, int fd);
   std::string op_watch_cancel(const JsonObject& req, int fd);
   std::string op_shard_map(const JsonObject& req);
@@ -483,6 +493,7 @@ class Coordinator {
   void bump_epoch() { epoch_++; record_epoch(); notify_watchers(); }
   void notify_watchers();
   void push_notify(int fd, long long e);
+  void push_preempt(int fd, const std::string& worker, const Preempt& p);
   // FNV-1a 64-bit over the routing key. The constants are mirrored in
   // edl_tpu/coordinator/sharding.py — both sides MUST agree, or the client
   // routes a key to one shard while the root redirects it to another.
@@ -606,6 +617,16 @@ class Coordinator {
   // Connection-scoped (a dead fd is just erased in on_disconnect) — resume
   // across reconnects is the CLIENT's job via the watch cursor.
   std::unordered_set<int> watchers_;
+  // fd -> worker name given at subscribe time: lets a revocation notice be
+  // pushed only to the doomed worker's watch connections (epoch frames stay
+  // broadcast). Connection-scoped like watchers_ itself.
+  std::unordered_map<int, std::string> watcher_names_;
+  // Pending advance-notice revocations, worker -> live notice. DELIBERATELY
+  // volatile (not journaled): a restarted coordinator forgets notices and
+  // the scheduler re-issues them — the EDL010 ladder proves the recovery
+  // wipe is honest. Cleared when the worker actually departs (drop_member).
+  std::map<std::string, Preempt> preempts_;
+  long long preempt_seq_ = 0;
   std::vector<std::string> shard_endpoints_;  // root mode: addr per shard slot
   long long shard_index_ = -1;                // shard mode: this server's slot
   long long num_shards_ = 0;
@@ -975,6 +996,21 @@ void Coordinator::notify_watchers() {
   for (int fd : watchers_) push_notify(fd, epoch_);
 }
 
+// Targeted push (op "preempt_notice"): unlike epoch frames, a revocation
+// notice goes only to the doomed worker's watch connections. Frames carry
+// no wall clock — the client anchors the drain deadline to its own
+// monotonic arrival time plus notice_s, so clock skew between scheduler,
+// coordinator, and worker never shortens the budget.
+void Coordinator::push_preempt(int fd, const std::string& worker,
+                               const Preempt& p) {
+  deferred_.push_back({fd, JsonWriter().field("ok", true)
+      .field("notify", "preempt").field("worker", worker)
+      .field("notice_s", p.notice_s).field("reason", p.reason)
+      .field("seq", (double)p.seq).field("epoch", (double)epoch_)
+      .field("cursor", (double)epoch_)
+      .field("world", (double)members_.size()).done()});
+}
+
 // Root shard routing: the root owns membership only, so a keyspace op is
 // answered with the owning shard's endpoint + slot instead of being served.
 // Clients cache the shard map and re-resolve when they see this reply.
@@ -1000,6 +1036,9 @@ void Coordinator::drop_member(const std::string& name) {
     // goes back to the queue (master semantics on task timeout).
     requeue_worker_leases(name);
     acquire_cache_.erase(name);
+    // The departure a notice predicted has happened: the revocation is
+    // consumed (a re-registered successor under this name is fresh capacity).
+    preempts_.erase(name);
     release_sync(false);
   }
 }
@@ -1466,6 +1505,35 @@ std::string Coordinator::op_bump_epoch() {
   return JsonWriter().field("ok", true).done();
 }
 
+std::string Coordinator::op_preempt_notice(const JsonObject& req) {
+  // Advance-notice revocation (spot/preemptible capacity): the scheduler
+  // names the doomed workers and the notice budget; each target's live
+  // watch connections get a targeted push within the same event-loop turn.
+  // No membership change happens here — the notice is a policy INPUT; the
+  // drain it triggers ends in leave -> drop_member like any departure.
+  auto it = req.find("targets");
+  if (it == req.end() || it->second.kind != JsonValue::kStrArray ||
+      it->second.arr.empty())
+    return JsonWriter().field("ok", false)
+        .field("error", "targets array required").done();
+  double notice_s = get_num(req, "notice_s", 0);
+  std::string reason = get_str(req, "reason");
+  if (reason.empty()) reason = "preempt";
+  std::vector<std::string> revoked;
+  revoked.reserve(it->second.arr.size());
+  for (const std::string& t : it->second.arr) {
+    Preempt p;
+    p.notice_s = notice_s;
+    p.reason = reason;
+    p.seq = ++preempt_seq_;
+    preempts_[t] = p;
+    for (auto& [fd, name] : watcher_names_)
+      if (name == t) push_preempt(fd, t, p);
+    revoked.push_back(t);
+  }
+  return JsonWriter().field("ok", true).field("revoked", revoked).done();
+}
+
 std::string Coordinator::op_watch(const JsonObject& req, int fd) {
   // Push subscription: this fd now receives a notification frame on every
   // epoch bump. cursor >= 0 resumes a subscription after a reconnect:
@@ -1474,10 +1542,17 @@ std::string Coordinator::op_watch(const JsonObject& req, int fd) {
   // each one rather than only the endpoint. The ack's cursor equals the
   // current epoch: "you are caught up as of here".
   long long cursor = (long long)get_num(req, "cursor", -1);
+  std::string worker = get_str(req, "worker");
   watchers_.insert(fd);
+  if (!worker.empty()) watcher_names_[fd] = worker;
   if (cursor >= 0) {
     for (long long e = cursor + 1; e <= epoch_; e++) push_notify(fd, e);
   }
+  // A notice posted before this subscription (or lost across a reconnect)
+  // is replayed here — delivery is at-least-once; clients dedup on seq.
+  auto pit = preempts_.find(worker);
+  if (!worker.empty() && pit != preempts_.end())
+    push_preempt(fd, worker, pit->second);
   deferred_.push_back({fd, JsonWriter().field("ok", true)
       .field("watch", true).field("cursor", (double)epoch_)
       .field("epoch", (double)epoch_).done()});
@@ -1487,6 +1562,7 @@ std::string Coordinator::op_watch(const JsonObject& req, int fd) {
 std::string Coordinator::op_watch_cancel(const JsonObject& req, int fd) {
   (void)req;
   bool cancelled = watchers_.erase(fd) > 0;
+  watcher_names_.erase(fd);
   return JsonWriter().field("ok", true).field("cancelled", cancelled).done();
 }
 
@@ -1514,6 +1590,13 @@ std::string Coordinator::op_status() {
   for (auto& [worker, tasks] : leases_by_worker_)
     if (!tasks.empty())
       holders.push_back(worker + "=" + std::to_string(tasks.size()));
+  // Pending revocations ride the same flat "worker=value" encoding as
+  // lease_holders; notice_s is integer-truncated so the string is
+  // deterministic across backends (the twin formats with int()).
+  std::vector<std::string> pending;
+  pending.reserve(preempts_.size());
+  for (auto& [worker, p] : preempts_)
+    pending.push_back(worker + "=" + std::to_string((long long)p.notice_s));
   return JsonWriter()
       .field("ok", true)
       .field("world", (double)members_.size())
@@ -1529,6 +1612,7 @@ std::string Coordinator::op_status() {
       .field("turns", (double)turns_)
       .field("uptime_seconds", now_sec() - boot_sec_)
       .field("lease_holders", holders)
+      .field("preempts", pending)
       .done();
 }
 
@@ -1629,6 +1713,7 @@ std::string Coordinator::dispatch(const std::string& op, const JsonObject& req,
   if (op == "shard_meta") return op_shard_meta(req);
   if (op == "shard_drop") return op_shard_drop(req);
   if (op == "bump_epoch") return op_bump_epoch();
+  if (op == "preempt_notice") return op_preempt_notice(req);
   if (op == "watch") return op_watch(req, fd);
   if (op == "watch_cancel") return op_watch_cancel(req, fd);
   if (op == "shard_map") return op_shard_map(req);
@@ -1641,6 +1726,7 @@ void Coordinator::on_disconnect(int fd) {
   // A watch subscription is connection-scoped: the client resumes on its
   // next connection with the cursor it last observed.
   watchers_.erase(fd);
+  watcher_names_.erase(fd);
   // Withdraw the worker's pending barrier arrival along with its waiter
   // entry: a crashed/disconnected worker must not count toward the barrier
   // (matches the Python twin's timeout withdrawal) — otherwise survivors

@@ -1,0 +1,141 @@
+"""Revocation wave: two jobs drained by one scripted fault timeline.
+
+The `make chaos-preempt` scenario. A spot reclaim rarely takes one host —
+a capacity crunch revokes SLICES, often hitting several jobs in the same
+minute. This test runs two independent training jobs (own coordinator, own
+task queue, own replica peer) and conducts a scripted revocation wave
+through :class:`ChaosScenario`: each job's doomed worker is revoked once it
+is warm (progress-gated, not wall-clock-gated — deterministic across
+machine speeds), drains inside its notice, and a survivor finishes the
+queue. The contract under the wave is the same as for a single notice:
+``steps_lost == 0`` and EXACT step accounting on both jobs, with the fired
+fault timeline replayable from its JSON spec.
+"""
+
+import json
+import threading
+
+import pytest
+
+from edl_tpu.coordinator import InProcessCoordinator
+from edl_tpu.models import fit_a_line
+from edl_tpu.runtime.data import SyntheticShardSource, shard_names
+from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+from edl_tpu.testing import ChaosScenario
+
+pytestmark = [pytest.mark.chaos]
+
+N_SHARDS, BPS, BATCH = 6, 6, 16
+
+
+class _Job:
+    """One training job: coordinator, doomed worker, follower peer."""
+
+    def __init__(self, tag, tmp_path):
+        self.tag = tag
+        self.model = fit_a_line.MODEL
+        self.coord = InProcessCoordinator(task_lease_sec=60.0,
+                                          heartbeat_ttl_sec=60.0)
+        self.admin = self.coord.client(f"admin-{tag}")
+        self.admin.add_tasks(shard_names(f"wave-{tag}", N_SHARDS))
+        self.workdir = tmp_path / tag
+        self.doomed = self._worker("trainer-0")
+        self.result = {}
+        self._stop = threading.Event()
+        self._threads = []
+
+    def _worker(self, name):
+        return ElasticWorker(
+            self.model, self.coord.client(name),
+            SyntheticShardSource(self.model, batch_size=BATCH,
+                                 batches_per_shard=BPS),
+            ElasticConfig(checkpoint_dir=str(self.workdir / "ck"),
+                          checkpoint_interval=50,
+                          heartbeat_interval=0.0,
+                          rescale_barrier_timeout=30.0,
+                          peer_replicas=1),
+        )
+
+    def _follow(self):
+        import time
+        j = self.coord.client("trainer-1")
+        info = j.register()
+        epoch = info["epoch"]
+        while not self._stop.is_set():
+            reply = j.sync(epoch, timeout=5.0)
+            if reply.get("ok"):
+                break
+            epoch = reply.get("epoch", epoch)
+        while not self._stop.is_set():
+            hb = j.heartbeat()
+            if hb.get("ok") and hb["epoch"] != epoch:
+                epoch = hb["epoch"]
+                j.sync(epoch, timeout=5.0)
+            time.sleep(0.02)
+
+    def start(self):
+        def run():
+            self.result.update(self.doomed.run())
+        for target in (self._follow, run):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def finish(self):
+        # the doomed worker's run() thread ends when its drain completes.
+        self._threads[1].join(timeout=120)
+        assert not self._threads[1].is_alive(), f"job {self.tag} never drained"
+        survivor = self._worker("trainer-2")
+        rest = survivor.run()
+        self._stop.set()
+        self._threads[0].join(timeout=10)
+        return rest, survivor
+
+
+def test_revocation_wave_drains_two_jobs_with_zero_steps_lost(tmp_path):
+    alpha = _Job("alpha", tmp_path)
+    beta = _Job("beta", tmp_path)
+
+    sc = (ChaosScenario("revocation-wave")
+          .register_coordinator("alpha", alpha.admin)
+          .register_coordinator("beta", beta.admin)
+          .predicate("alpha_warm", lambda: alpha.doomed.steps_done >= 3)
+          .predicate("beta_warm", lambda: beta.doomed.steps_done >= 3)
+          .add("alpha.revoke", when="alpha_warm", worker="trainer-0",
+               notice_s=30.0, reason="spot-wave")
+          .add("beta.revoke", when="beta_warm", after=0.05,
+               worker="trainer-0", notice_s=30.0, reason="spot-wave"))
+
+    # the preempt instruments live in the global registry: both jobs (and
+    # earlier tests in this process) share the counter cells, so the wave's
+    # contribution is asserted as a delta.
+    notices_before = alpha.doomed.preempt_obs.notices.value(
+        reason="spot-wave")
+
+    alpha.start()
+    beta.start()
+    sc.start()
+    sc.join(timeout=120)
+    assert sc.completed and sc.failed is None, sc.events
+    assert [e["action"] for e in sc.events] == ["alpha.revoke", "beta.revoke"]
+
+    for job in (alpha, beta):
+        rest, _ = job.finish()
+        doomed = job.result
+        assert doomed["preempted"] == 1.0, (job.tag, doomed)
+        assert doomed["steps_lost"] == 0.0
+        assert doomed["preempt_deadline_met"] == 1.0
+        assert doomed["notice_to_drained_seconds"] < 30.0
+        # exact accounting: the wave lost nothing and replayed nothing.
+        assert doomed["steps"] + rest["steps"] == N_SHARDS * BPS, job.tag
+
+    assert alpha.doomed.preempt_obs.notices.value(reason="spot-wave") \
+        == notices_before + 2  # one notice per job, none duplicated
+
+    # the fired timeline replays: the spec round-trips through JSON with
+    # the revocation kwargs (worker, notice_s, reason) intact.
+    replay = ChaosScenario.from_spec(sc.spec())
+    assert [s.to_dict() for s in replay.steps] == \
+        [s.to_dict() for s in sc.steps]
+    assert replay.steps[0].kwargs["worker"] == "trainer-0"
+    assert json.loads(sc.spec())["name"] == "revocation-wave"

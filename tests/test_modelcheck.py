@@ -84,11 +84,13 @@ def test_default_exhaustive_is_green_and_fully_replayed():
     elapsed = time.monotonic() - t0
     assert result.violations == []
     # C(13, 6) interleavings of the default scripts + C(8, 4) of the
-    # checkpoint-plane schedule + C(11, 3) watch/notify + C(10, 4)
-    # redirect-during-watch + the EDL010 durability rows (POR-reduced
-    # except durability-compact, which runs unreduced at C(13, 6)):
-    # 118 + 50 + 28 + 1716 + 21 + 196 = 2129. run_default merges all ten.
-    assert result.traces == 1716 + 70 + 165 + 210 + 2129
+    # checkpoint-plane schedule + C(11, 3) watch/notify + the preempt
+    # notice/watch/leave lane + C(10, 4) redirect-during-watch + the
+    # EDL010 durability rows (POR-reduced except durability-compact,
+    # which runs unreduced at C(13, 6)):
+    # 118 + 50 + 28 + 1716 + 21 + 196 + 38 = 2167. run_default merges
+    # all twelve.
+    assert result.traces == 1716 + 70 + 165 + 210 + 210 + 2167
     assert result.replays == result.traces
     assert result.ok()
     assert elapsed < 90.0
@@ -156,9 +158,9 @@ def test_mutant_violation_messages_name_the_replayed_request():
 def test_fuzz_on_green_twin_stays_green():
     result = run_default(fuzz_samples=40, fuzz_seed=7)
     assert result.violations == []
-    # 40 samples per schedule (4 legacy + 6 durability rows), identical
+    # 40 samples per schedule (5 legacy + 7 durability rows), identical
     # ones dedup
-    assert 0 < result.traces <= 400
+    assert 0 < result.traces <= 480
     assert result.replays == result.traces
 
 
@@ -201,8 +203,9 @@ def test_durability_schedules_green_with_pinned_trace_counts():
         "durability-compact": 1716,       # snapshot path, unreduced C(13,6)
         "durability-crash-compact": 21,   # crash inside snapshot write
         "durability-shard": 196,          # unjournaled shard-store honesty
+        "durability-preempt": 38,         # volatile notices forgotten by crash
     }
-    assert sum(counts.values()) == 2129
+    assert sum(counts.values()) == 2167
 
 
 def test_schedule_name_filter_rejects_unknown_names():
@@ -432,7 +435,7 @@ def test_cli_exhaustive_exits_zero(capsys):
     rc = modelcheck_main([])
     out = capsys.readouterr().out
     assert rc == 0
-    assert "4290 trace(s)" in out and "0 violation(s)" in out
+    assert "4538 trace(s)" in out and "0 violation(s)" in out
 
 
 def test_cli_json_fuzz(capsys):

@@ -250,8 +250,8 @@ def test_rescale_timeline_stitches_components_and_dedupes():
 def test_rescale_phase_vocabulary_is_stable():
     # the bench artifact and the e2e test are written against these names
     assert RESCALE_PHASES == (
-        "drain", "checkpoint", "replan", "warm_compile", "restore",
-        "reshard", "first_step"
+        "preempt_drain", "drain", "checkpoint", "replan", "warm_compile",
+        "restore", "reshard", "first_step"
     )
 
 

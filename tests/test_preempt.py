@@ -1,0 +1,463 @@
+"""Preemption-native capacity: advance-notice drains and straggler eviction.
+
+Unit coverage for the revocation path's planks — the notice-budget policy
+decision (fake clock), straggler quantile math (trailing window, hysteresis,
+cooldown), replica-ring placement overrides (revoked ranks never HOLD a
+replica), the watch client's preempt-frame handling (seq dedup, replay),
+and the LeaseReader's replay-free boundary drain — plus the single-worker
+e2e: a live ElasticWorker revoked mid-training drains inside its notice
+with zero lost steps. The two-job revocation WAVE (scripted ChaosScenario)
+lives in ``tests/test_chaos_preempt.py`` (`make chaos-preempt`).
+"""
+
+import threading
+import time
+
+import pytest
+
+from edl_tpu.ckpt_plane.placement import (
+    PLACEMENT_KEY, placement_map, replica_group,
+)
+from edl_tpu.coordinator import InProcessCoordinator
+from edl_tpu.coordinator.watch import make_epoch_watch
+from edl_tpu.models import fit_a_line
+from edl_tpu.obs.instruments import PreemptInstruments
+from edl_tpu.obs.metrics import MetricsRegistry
+from edl_tpu.obs.tracing import Tracer
+from edl_tpu.runtime.data import (
+    LeaseReader, SyntheticShardSource, shard_names,
+)
+from edl_tpu.runtime.elastic import ElasticConfig, ElasticWorker
+from edl_tpu.runtime.ft_policy import (
+    DRAIN_SHRINK, PARK, RIDE_OUT, FTPolicy, FTPolicyConfig,
+)
+from edl_tpu.runtime.straggler import (
+    StragglerConfig, StragglerDetector, nearest_rank_quantile,
+)
+
+pytestmark = [pytest.mark.chaos]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- placement override: revoked ranks never hold a replica --------------------
+
+
+def test_replica_group_excludes_revoked_ranks():
+    for world in (2, 4, 6, 8):
+        for k in (1, 2, 3):
+            for revoked in ([0], [world - 1], [1, 2]):
+                for rank in range(world):
+                    group = replica_group(rank, world, k, exclude=revoked)
+                    assert not set(group) & set(revoked), (
+                        f"replica landed on revoked rank: world={world} "
+                        f"k={k} rank={rank} revoked={revoked} -> {group}")
+                    assert rank not in group
+
+
+def test_replica_group_keeps_k_holders_when_survivors_suffice():
+    # world 6, k=2, rank 0's natural ring is (1, 2); banning 1 must walk
+    # PAST it to (2, 3), not shrink the group.
+    assert replica_group(0, 6, 2, exclude=[1]) == [2, 3]
+
+
+def test_replica_group_clamps_k_to_surviving_candidates():
+    # world 3, rank 0, k=2: candidates are {1, 2}; revoking 2 leaves one.
+    assert replica_group(0, 3, 2, exclude=[2]) == [1]
+    # every candidate revoked: no holders, owner keeps the only copy.
+    assert replica_group(0, 2, 1, exclude=[1]) == []
+
+
+def test_placement_map_with_exclusions_covers_survivors_only():
+    revoked = [1]
+    m = placement_map(4, 2, exclude=revoked)
+    assert set(m) == {0, 1, 2, 3}  # revoked ranks still OWN their shard
+    for rank, group in m.items():
+        assert not set(group) & set(revoked), (rank, group)
+
+
+def test_publish_placement_documents_exclusions():
+    from edl_tpu.ckpt_plane.placement import publish_placement
+    import json
+
+    coord = InProcessCoordinator()
+    c = coord.client("w0")
+    c.register()
+    doc = publish_placement(c, epoch=3, world=4, k=1, exclude=[2])
+    raw = c.kv_get(PLACEMENT_KEY.format(epoch=3))
+    stored = json.loads(raw)
+    assert stored == doc
+    assert stored["excluded"] == [2]
+    for group in stored["groups"].values():
+        assert 2 not in group
+
+
+# -- straggler quantile math ---------------------------------------------------
+
+
+def test_nearest_rank_quantile_matches_by_hand():
+    assert nearest_rank_quantile([], 0.95) == 0.0
+    assert nearest_rank_quantile([3.0, 1.0, 2.0], 0.5) == 2.0
+    assert nearest_rank_quantile([1.0, 2.0, 3.0, 4.0], 1.0) == 4.0
+
+
+def _detector(**kw):
+    clock = FakeClock()
+    cfg = StragglerConfig(window_steps=32, min_samples=16,
+                          consecutive_breaches=3, **kw)
+    det = StragglerDetector(cfg, PreemptInstruments(MetricsRegistry()),
+                            clock=clock)
+    return det, clock
+
+
+def test_uniform_noise_never_evicts():
+    det, _ = _detector()
+    # 3 hosts, same distribution with deterministic jitter.
+    for i in range(64):
+        for h, base in (("h0", 1.0), ("h1", 1.0), ("h2", 1.0)):
+            det.note_step(h, base + 0.01 * ((i * 7 + hash(h) % 5) % 11))
+        if i % 4 == 0:
+            assert det.evaluate() == []
+
+
+def test_sustained_p95_breach_evicts_after_hysteresis():
+    det, _ = _detector()
+    for i in range(40):
+        det.note_step("good-a", 1.0)
+        det.note_step("good-b", 1.0)
+        det.note_step("slow", 2.0)  # 2x the fleet, persistently
+    verdicts = []
+    rounds = 0
+    while not verdicts and rounds < 10:
+        verdicts = det.evaluate()
+        rounds += 1
+    assert verdicts == ["slow"]
+    # hysteresis: it took exactly consecutive_breaches evaluations.
+    assert rounds == det.config.consecutive_breaches
+
+
+def test_one_slow_step_never_evicts():
+    """A single outlier step — GC pause, one bad batch — must not condemn
+    the host: nearest-rank p95 over the window shrugs it off AND the
+    breach streak requires consecutive evaluations."""
+    det, _ = _detector()
+    for i in range(40):
+        det.note_step("h0", 1.0)
+        det.note_step("h1", 1.0)
+    det.note_step("h0", 50.0)  # one catastrophic step
+    for _ in range(6):
+        assert det.evaluate() == []
+
+
+def test_single_breach_evaluation_resets_on_recovery():
+    det, _ = _detector()
+    for _ in range(32):
+        det.note_step("h0", 2.0)
+        det.note_step("h1", 1.0)
+        det.note_step("h2", 1.0)
+    assert det.evaluate() == []  # breach 1 of 3
+    assert det.evaluate() == []  # breach 2 of 3
+    # host recovers before the third evaluation: window refills healthy.
+    for _ in range(32):
+        det.note_step("h0", 1.0)
+        det.note_step("h1", 1.0)
+        det.note_step("h2", 1.0)
+    for _ in range(6):
+        assert det.evaluate() == []  # streak reset, never evicted
+
+
+def test_cooldown_suppresses_repeat_verdicts():
+    det, clock = _detector(cooldown_s=300.0)
+    for _ in range(40):
+        det.note_step("slow", 2.0)
+        det.note_step("h1", 1.0)
+        det.note_step("h2", 1.0)
+    verdicts = []
+    for _ in range(5):
+        verdicts += det.evaluate()
+    assert verdicts == ["slow"]  # one verdict, then cooldown
+    clock.advance(301.0)
+    verdicts = []
+    for _ in range(5):
+        verdicts += det.evaluate()
+    assert verdicts == ["slow"]  # cooldown expired, still slow -> again
+
+
+def test_fleet_of_one_is_never_evaluated():
+    det, _ = _detector()
+    for _ in range(64):
+        det.note_step("only", 9.0)
+    assert det.evaluate() == []
+
+
+def test_evict_routes_through_preempt_notice():
+    det, _ = _detector(notice_s=17.0)
+
+    class FakeClient:
+        def __init__(self):
+            self.calls = []
+
+        def preempt_notice(self, targets, notice_s=30.0, reason="preempt"):
+            self.calls.append((list(targets), notice_s, reason))
+            return list(targets)
+
+    client = FakeClient()
+    revoked = det.evict(client, ["slow-host"])
+    assert revoked == ["slow-host"]
+    assert client.calls == [(["slow-host"], 17.0, "straggler")]
+    assert det.evictions == 1
+    assert det.evict(client, []) == []
+
+
+# -- the notice-budget decision ------------------------------------------------
+
+
+def _policy(**cfg_kw):
+    clock = FakeClock()
+    from edl_tpu.obs.instruments import FTPolicyInstruments
+
+    tracer = Tracer(component="test")
+    p = FTPolicy(FTPolicyConfig(**cfg_kw), worker="wtest",
+                 instruments=FTPolicyInstruments(MetricsRegistry()),
+                 tracer=tracer, clock=clock)
+    return p, clock, tracer
+
+
+def test_notice_budget_decision_table():
+    p, _, _ = _policy(notice_margin=1.0)
+    # measured costs: ckpt 4 s, restore 2 s, replan 1 s -> drain 7 s
+    for _ in range(4):
+        p.note_checkpoint_cost(4.0)
+        p.note_restore_cost(2.0)
+        p.note_replan_cost(1.0)
+    assert p.drain_cost() == pytest.approx(7.0)
+    assert p.on_preempt_notice(60.0) == DRAIN_SHRINK  # budget >> drain
+    assert p.on_preempt_notice(5.0) == PARK  # ckpt fits, full drain doesn't
+    assert p.on_preempt_notice(2.0) == RIDE_OUT  # not even a ckpt fits
+    assert p.on_preempt_notice(-1.0) == RIDE_OUT  # deadline already passed
+
+
+def test_notice_margin_derates_the_budget():
+    p, _, _ = _policy(notice_margin=2.0)
+    for _ in range(4):
+        p.note_checkpoint_cost(4.0)
+        p.note_restore_cost(2.0)
+        p.note_replan_cost(1.0)
+    # drain prices at 7 s, a ckpt at 4 s. 6 s of notice is only 3 s of
+    # derated budget: not even the ckpt fits -> ride out rather than miss
+    # the deadline mid-save. 10 s derates to 5 s: ckpt yes, drain no.
+    assert p.on_preempt_notice(6.0) == RIDE_OUT
+    assert p.on_preempt_notice(10.0) == PARK
+
+
+def test_cold_start_is_optimistic_drain():
+    p, _, _ = _policy()
+    # nothing measured: drain prices at 0 and any positive budget drains.
+    assert p.on_preempt_notice(1.0) == DRAIN_SHRINK
+
+
+def test_ft_decision_span_carries_notice_remaining():
+    p, _, tracer = _policy()
+    p.on_preempt_notice(42.0)
+    spans = [s for s in tracer.spans if s.name == "ft_decision"]
+    assert spans and spans[-1].attrs["notice_remaining_s"] == 42.0
+    assert "drain_cost" in spans[-1].attrs
+    assert "drain_cost" in p.state()
+
+
+# -- watch client: preempt frames ----------------------------------------------
+
+
+def test_preempt_frame_pushes_to_live_subscriber():
+    coord = InProcessCoordinator()
+    w0 = coord.client("w0")
+    w0.register()
+    watch = make_epoch_watch(w0, "watch")
+    assert watch.subscribe()
+    admin = coord.client("admin")
+    admin.register()
+    t0 = time.monotonic()
+    assert admin.preempt_notice(["w0"], notice_s=30.0,
+                                reason="spot") == ["w0"]
+    watch.poll()
+    notices = watch.take_preempts()
+    assert len(notices) == 1
+    n = notices[0]
+    assert n["worker"] == "w0" and n["reason"] == "spot"
+    assert n["notice_s"] == 30.0 and n["seq"] == 1
+    assert t0 <= n["arrival"] <= n["deadline"] - 29.0
+    assert watch.take_preempts() == []  # drained
+
+
+def test_preempt_replays_to_late_subscriber_and_dedups():
+    coord = InProcessCoordinator()
+    w0 = coord.client("w0")
+    w0.register()
+    admin = coord.client("admin")
+    admin.register()
+    admin.preempt_notice(["w0"], notice_s=45.0, reason="maint")
+    # Subscribe AFTER the notice: the pending revocation must replay.
+    watch = make_epoch_watch(w0, "watch")
+    assert watch.subscribe()
+    watch.poll()
+    assert [n["seq"] for n in watch.take_preempts()] == [1]
+    # Resubscribe (dropped connection): the same frame replays but the
+    # seq dedup drops it — at-least-once delivery, exactly-once action.
+    assert watch.subscribe()
+    watch.poll()
+    assert watch.take_preempts() == []
+
+
+def test_leave_consumes_the_notice_and_status_renders_it():
+    coord = InProcessCoordinator()
+    w0 = coord.client("w0")
+    w0.register()
+    admin = coord.client("admin")
+    admin.register()
+    admin.preempt_notice(["w0"], notice_s=30.0)
+    st = admin.call("status")
+    assert st["preempts"] == ["w0=30"]
+    w0.leave()
+    st = admin.call("status")
+    assert st.get("preempts", []) == []
+
+
+def test_preempt_notice_requires_targets():
+    coord = InProcessCoordinator()
+    admin = coord.client("admin")
+    admin.register()
+    reply = admin.call("preempt_notice", targets=[], notice_s=5.0)
+    assert reply["ok"] is False and "targets" in reply["error"]
+
+
+# -- LeaseReader: replay-free boundary drain -----------------------------------
+
+
+def test_soft_stop_finishes_in_flight_shard_without_replay():
+    coord = InProcessCoordinator(task_lease_sec=30.0)
+    c = coord.client("r1")
+    c.register()
+    c.add_tasks(shard_names("drain", 3))
+    model = fit_a_line.MODEL
+    source = SyntheticShardSource(model, batch_size=8, batches_per_shard=4)
+
+    count = [0]
+    # Soft signal fires mid-shard-0 (after 2 of 4 batches) — the reader
+    # must FINISH shard 0, complete it, and stop before leasing shard 1.
+    reader = LeaseReader(c, source,
+                         soft_stop_check=lambda: count[0] >= 2)
+    for batch in reader:
+        count[0] += 1
+    assert reader.drained and reader.interrupted is None
+    assert not reader.exhausted
+    assert count[0] == 4  # the in-flight shard ran to its boundary
+    assert reader.completed == ["drain/part-00000"]
+
+    # Nothing failed back: a second reader sees exactly the two untouched
+    # shards — zero replay.
+    reader2 = LeaseReader(c, source)
+    seen = 0
+    for _ in reader2:
+        seen += 1
+    assert reader2.exhausted
+    assert seen == 8
+    assert set(reader2.completed) == {"drain/part-00001", "drain/part-00002"}
+
+
+# -- e2e: a live worker revoked mid-training -----------------------------------
+
+
+def test_elastic_worker_drains_on_notice_with_zero_steps_lost(tmp_path):
+    """The single-job tentpole e2e: trainer-0 trains under world=2, the
+    'scheduler' revokes it with 30 s notice, the policy picks drain-and-
+    shrink, the worker finishes its in-flight shard, evacuates, leaves
+    before the deadline, and a survivor drains the rest — with EXACT step
+    accounting (nothing lost, nothing replayed)."""
+    model = fit_a_line.MODEL
+    n_shards, bps, batch = 6, 6, 16
+    coord = InProcessCoordinator(task_lease_sec=60.0, heartbeat_ttl_sec=60.0)
+    admin = coord.client("admin")
+    admin.add_tasks(shard_names("spot", n_shards))
+
+    def make_worker(name):
+        return ElasticWorker(
+            model, coord.client(name),
+            SyntheticShardSource(model, batch_size=batch,
+                                 batches_per_shard=bps),
+            ElasticConfig(checkpoint_dir=str(tmp_path / "ck"),
+                          checkpoint_interval=50,
+                          heartbeat_interval=0.0,  # check watch every batch
+                          rescale_barrier_timeout=30.0,
+                          peer_replicas=1),
+        )
+
+    worker = make_worker("trainer-0")
+    stop = threading.Event()
+
+    def follow():
+        """trainer-1: surviving member / replica-ring peer."""
+        j = coord.client("trainer-1")
+        info = j.register()
+        epoch = info["epoch"]
+        while not stop.is_set():
+            reply = j.sync(epoch, timeout=5.0)
+            if reply.get("ok"):
+                break
+            epoch = reply.get("epoch", epoch)
+        while not stop.is_set():
+            hb = j.heartbeat()
+            if hb.get("ok") and hb["epoch"] != epoch:
+                epoch = hb["epoch"]
+                j.sync(epoch, timeout=5.0)
+            time.sleep(0.02)
+
+    follower = threading.Thread(target=follow, daemon=True)
+    follower.start()
+
+    def scheduler():
+        t0 = time.time()
+        while worker.steps_done < 3 and time.time() - t0 < 60:
+            time.sleep(0.01)
+        admin.preempt_notice(["trainer-0"], notice_s=30.0,
+                             reason="spot-reclaim")
+
+    # preempt instruments live in the global registry (cells persist
+    # across tests in this process): assert deltas, not absolutes.
+    notices0 = worker.preempt_obs.notices.value(reason="spot-reclaim")
+    evict0 = worker.preempt_obs.evictions.value(trigger="revocation")
+
+    sched = threading.Thread(target=scheduler, daemon=True)
+    sched.start()
+    try:
+        doomed = worker.run()
+    finally:
+        sched.join(timeout=30)
+    assert doomed["preempted"] == 1.0
+    assert doomed["steps_lost"] == 0.0
+    assert doomed["preempt_deadline_met"] == 1.0
+    assert doomed["notice_to_drained_seconds"] < 30.0
+    assert worker.preempt_obs.notices.value(reason="spot-reclaim") \
+        == notices0 + 1
+    assert worker.preempt_obs.evictions.value(trigger="revocation") \
+        == evict0 + 1
+
+    survivor = make_worker("trainer-2")
+    try:
+        rest = survivor.run()
+    finally:
+        stop.set()
+        follower.join(timeout=10)
+    # exact accounting: doomed + survivor == workload, zero replays.
+    assert doomed["steps"] + rest["steps"] == n_shards * bps
+    # the survivor restored the doomed worker's evacuated progress: its
+    # state resumed at the doomed step count, not from zero.
+    assert survivor._last_restore["source"] in ("peer", "blob")
