@@ -3,9 +3,9 @@
 
 PYTHON ?= python
 
-ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/ckpt_plane edl_tpu/parallel/planner.py edl_tpu/runtime/compile_cache.py bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
+ANALYZE_SCOPE = edl_tpu edl_tpu/serving edl_tpu/serving/kvcache.py edl_tpu/serving/router.py edl_tpu/ckpt_plane edl_tpu/parallel/planner.py edl_tpu/runtime/compile_cache.py bench.py bench_rescale.py bench_pipeline.py bench_coord.py bench_collective.py bench_serve.py
 
-.PHONY: analyze analyze-json baseline test chaos chaos-composed chaos-preempt lint obs-smoke serve-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke bench-replan-smoke bench-spot-smoke verify bench-pipeline bench-coord bench-collective bench-serve
+.PHONY: analyze analyze-json baseline test chaos chaos-composed chaos-preempt lint obs-smoke serve-smoke serve-lm-smoke ckpt-plane-smoke modelcheck modelcheck-native tsan-smoke bench-coord-smoke bench-replan-smoke bench-spot-smoke verify bench-pipeline bench-coord bench-collective bench-serve
 
 analyze:
 	$(PYTHON) -m edl_tpu.analysis $(ANALYZE_SCOPE)
@@ -55,6 +55,16 @@ obs-smoke:
 ## and the empty-jit-dispatch-cache AOT contract. See doc/serving.md.
 serve-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.serving
+
+## LM-serving deploy gate: exports a small transformer, boots an
+## LMServingReplica (prefill + decode AOT-compiled per (batch bucket, seq
+## bucket)), decodes a concurrent prompt batch through POST /generate,
+## then asserts zero dropped streams, exact token accounting, the
+## edl_lm_* metric families, a fully-recycled KV block pool, and the
+## empty-jit-dispatch-cache contract across both phases. See
+## doc/serving.md ("LM serving").
+serve-lm-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) -m edl_tpu.serving lm
 
 ## Checkpoint-plane deploy gate: trains a twin, replicates ZeRO shards to
 ## the coordinator's memory-resident store, kills the live state, peer-
@@ -141,7 +151,7 @@ bench-spot-smoke:
 ## lane, revocation-wave chaos, bench-harness smokes (coordinator +
 ## replanner + spot drain). Tier-2 (slow, run before cutting a release):
 ## `make chaos` / `make chaos-composed`.
-verify: analyze test modelcheck modelcheck-native serve-smoke ckpt-plane-smoke tsan-smoke chaos-preempt bench-coord-smoke bench-replan-smoke bench-spot-smoke
+verify: analyze test modelcheck modelcheck-native serve-smoke serve-lm-smoke ckpt-plane-smoke tsan-smoke chaos-preempt bench-coord-smoke bench-replan-smoke bench-spot-smoke
 
 ## Pipeline-schedule crossover sweep at CPU-sim scale; regenerates
 ## BENCH_PIPELINE.json (the artifact behind BENCH_NOTES.md's table).

@@ -289,6 +289,21 @@ def cmd_status(args) -> int:
     if serving:
         print("  serving replicas:")
         for worker, st in sorted(serving.items()):
+            if st.get("kind") == "lm":
+                # LM replicas publish stream/token/KV state instead of a
+                # request queue — render the decode-native numbers.
+                kv = st.get("kv") or {}
+                print(f"    {worker:<24} kind=lm "
+                      f"version={st.get('version')} "
+                      f"step={st.get('model_step')} "
+                      f"streams={st.get('active_streams')} "
+                      f"tokens/s={st.get('tokens_per_s')} "
+                      f"kv_blocks={kv.get('used_blocks')}/"
+                      f"{kv.get('n_blocks')} "
+                      f"free={kv.get('free_blocks')} "
+                      f"frag={kv.get('fragmentation')} "
+                      f"served={st.get('completed')}")
+                continue
             hits = st.get("bucket_hits") or {}
             hits_s = ",".join(f"{k}:{v}" for k, v in sorted(
                 hits.items(), key=lambda kv: int(kv[0]))) or "-"

@@ -585,6 +585,181 @@ def _flops_per_step(cfg: TransformerConfig, batch_size: int) -> float:
     return 3.0 * per_token * cfg.seq_len * batch_size
 
 
+# -- LM serving: prefill / single-token decode --------------------------------
+#
+# Training runs the whole forward as one shard_map kernel; serving an LM is
+# a different shape of work. Autoregressive traffic splits into two phases
+# with opposite hardware profiles (the prefill/decode separation every
+# production LM server makes):
+#
+# - **prefill** — the prompt's full causal forward, compute-bound, shaped
+#   (batch bucket, seq bucket). It returns the per-layer K/V it computed so
+#   decode never re-touches prompt tokens, plus the prompt's next token.
+# - **decode** — one token per step, memory-bound: each call reads the
+#   whole K/V cache once and appends one position. Its K/V write-back is
+#   returned to the caller (shaped (L, B, H, Dh)) instead of updating a
+#   cache in place, so the serving engine owns cache layout — per-stream
+#   host caches make per-token batch-membership changes free.
+#
+# Both are pure fixed-shape functions of (params, int32 arrays), AOT-
+# compilable per (batch bucket, seq bucket) with jit(...).lower().compile()
+# — the serve tier's empty-dispatch-cache contract extends to LM traffic.
+# They run replicated (the serving mesh gives non-data axes size 1), so no
+# collectives appear; matmuls in bf16, norms/softmax/logits in f32, same
+# discipline as the training kernel. Dense FFN only: MoE decode needs the
+# expert all_to_all plumbed through the cache path (not yet built).
+
+
+def lm_cache_shape(cfg: TransformerConfig) -> Tuple[int, int, int]:
+    """(n_layers, n_heads, head_dim) — the per-token K/V geometry the
+    serving tier sizes its block pool from."""
+    return (cfg.n_layers, cfg.n_heads, cfg.head_dim)
+
+
+def lm_cache_bytes_per_token(cfg: TransformerConfig) -> int:
+    """HBM bytes one token slot of K+V occupies (bf16 cache)."""
+    L, H, Dh = lm_cache_shape(cfg)
+    return 2 * L * H * Dh * 2  # K and V, 2 bytes each (bfloat16)
+
+
+def _check_lm_servable(cfg: TransformerConfig) -> None:
+    if cfg.moe_experts > 0:
+        raise NotImplementedError(
+            "LM serving path covers dense FFN configs only (MoE decode "
+            "needs the expert all_to_all plumbed through the cache path)"
+        )
+
+
+def _decode_attention(q, k_cache, v_cache, k_new, v_new, lengths, scale):
+    """One token's attention over its cache plus itself.
+
+    q/k_new/v_new: (B, H, Dh) bf16; caches (B, C, H, Dh) bf16; lengths
+    (B,) int32 = tokens already IN the cache (the new token's position).
+    Cache positions >= length are dead slots (pad garbage or not yet
+    written) and are masked out; the new token always attends to itself.
+    """
+    C = k_cache.shape[1]
+    scores = jnp.einsum(
+        "bhe,bche->bhc", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) * scale
+    valid = jnp.arange(C)[None, :] < lengths[:, None]  # (B, C)
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    self_score = jnp.sum(
+        q.astype(jnp.float32) * k_new.astype(jnp.float32), axis=-1
+    )[..., None] * scale  # (B, H, 1)
+    w = jax.nn.softmax(jnp.concatenate([scores, self_score], axis=-1), axis=-1)
+    out = jnp.einsum(
+        "bhc,bche->bhe", w[..., :C], v_cache.astype(jnp.float32)
+    ) + w[..., C:] * v_new.astype(jnp.float32)
+    return out.astype(jnp.bfloat16)
+
+
+def make_decode_step(cfg: TransformerConfig):
+    """Single-token decode: (params, k_cache, v_cache, tokens, lengths) ->
+    (next_tokens, k_new, v_new).
+
+    Shapes: caches (L, B, C, H, Dh) bf16 — C is the stream's seq-bucket
+    capacity; ``tokens`` (B,) the last emitted token ids; ``lengths`` (B,)
+    the token count already cached (== the new token's position). Returns
+    greedy-argmax next tokens (B,) int32 and the new position's per-layer
+    K/V (L, B, H, Dh) for the caller to append at index ``lengths``.
+    """
+    _check_lm_servable(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def step(params, k_cache, v_cache, tokens, lengths):
+        x = (params["embed"][tokens] + params["pos"][lengths]).astype(
+            jnp.bfloat16
+        )  # (B, D)
+
+        def body(x, layer):
+            bp, k_c, v_c = layer
+            h = _rmsnorm(x, bp["ln1"])
+            qkv = (
+                jnp.einsum("bd,dthe->bthe", h, bp["wqkv"].astype(jnp.bfloat16))
+                + bp["bqkv"].astype(jnp.bfloat16)
+            )  # (B, 3, H, Dh)
+            q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            attn = _decode_attention(q, k_c, v_c, k_new, v_new, lengths, scale)
+            out = jnp.einsum("bhe,hed->bd", attn, bp["wo"].astype(jnp.bfloat16))
+            x = x + (out.astype(jnp.float32) + bp["bo"]).astype(jnp.bfloat16)
+            h = _rmsnorm(x, bp["ln2"])
+            f = jnp.einsum("bd,df->bf", h, bp["win"].astype(jnp.bfloat16))
+            f = jax.nn.gelu(f + bp["bin"].astype(jnp.bfloat16))
+            o = jnp.einsum("bf,fd->bd", f, bp["wout"].astype(jnp.bfloat16))
+            x = x + (o.astype(jnp.float32) + bp["bout"]).astype(jnp.bfloat16)
+            return x, (k_new.astype(jnp.bfloat16), v_new.astype(jnp.bfloat16))
+
+        x, (k_appended, v_appended) = jax.lax.scan(
+            body, x, (params["blocks"], k_cache, v_cache)
+        )
+        h = _rmsnorm(x, params["lnf"]).astype(jnp.float32)
+        logits = jnp.einsum("bd,dv->bv", h, params["head"])
+        return (
+            jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            k_appended,
+            v_appended,
+        )
+
+    return step
+
+
+def make_prefill_step(cfg: TransformerConfig):
+    """Prompt prefill: (params, tokens, lengths) ->
+    (next_tokens, k_cache, v_cache).
+
+    ``tokens`` (B, S) right-padded int32 prompts, ``lengths`` (B,) real
+    token counts. Full causal attention over the padded bucket (pad
+    positions compute dead K/V the decode mask never reads); returns the
+    per-layer K/V for all S positions as (L, B, S, H, Dh) bf16 and the
+    greedy next token read at position ``lengths - 1``.
+    """
+    _check_lm_servable(cfg)
+    scale = 1.0 / math.sqrt(cfg.head_dim)
+
+    def step(params, tokens, lengths):
+        B, S = tokens.shape
+        pos = jnp.arange(S)
+        x = (params["embed"][tokens] + params["pos"][pos]).astype(jnp.bfloat16)
+        causal = pos[None, :] <= pos[:, None]  # (S, S) keys <= queries
+
+        def body(x, bp):
+            h = _rmsnorm(x, bp["ln1"])
+            qkv = (
+                jnp.einsum("bsd,dthe->bsthe",
+                           h, bp["wqkv"].astype(jnp.bfloat16))
+                + bp["bqkv"].astype(jnp.bfloat16)
+            )
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            scores = jnp.einsum(
+                "bshe,bthe->bhst", q.astype(jnp.float32),
+                k.astype(jnp.float32)
+            ) * scale
+            scores = jnp.where(causal[None, None], scores, -jnp.inf)
+            w = jax.nn.softmax(scores, axis=-1)
+            attn = jnp.einsum(
+                "bhst,bthe->bshe", w, v.astype(jnp.float32)
+            ).astype(jnp.bfloat16)
+            out = jnp.einsum("bshe,hed->bsd",
+                             attn, bp["wo"].astype(jnp.bfloat16))
+            x = x + (out.astype(jnp.float32) + bp["bo"]).astype(jnp.bfloat16)
+            h = _rmsnorm(x, bp["ln2"])
+            f = jnp.einsum("bsd,df->bsf", h, bp["win"].astype(jnp.bfloat16))
+            f = jax.nn.gelu(f + bp["bin"].astype(jnp.bfloat16))
+            o = jnp.einsum("bsf,fd->bsd", f, bp["wout"].astype(jnp.bfloat16))
+            x = x + (o.astype(jnp.float32) + bp["bout"]).astype(jnp.bfloat16)
+            return x, (k.astype(jnp.bfloat16), v.astype(jnp.bfloat16))
+
+        x, (k_cache, v_cache) = jax.lax.scan(body, x, params["blocks"])
+        last = jnp.clip(lengths - 1, 0, S - 1)
+        h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)[:, 0]
+        h_last = _rmsnorm(h_last, params["lnf"]).astype(jnp.float32)
+        logits = jnp.einsum("bd,dv->bv", h_last, params["head"])
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_cache, v_cache
+
+    return step
+
+
 def make_model(cfg: Optional[TransformerConfig] = None, **overrides) -> Model:
     cfg = cfg or TransformerConfig(**overrides)
     return Model(

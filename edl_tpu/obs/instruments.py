@@ -15,8 +15,9 @@ from typing import Optional
 from edl_tpu.obs.metrics import MetricsRegistry, get_registry
 
 __all__ = ["WorkerInstruments", "FTPolicyInstruments", "ServeInstruments",
-           "CkptPlaneInstruments", "PreemptInstruments", "OUTAGE_BUCKETS",
-           "SERVE_LATENCY_BUCKETS", "NOTICE_BUCKETS"]
+           "LMServeInstruments", "CkptPlaneInstruments", "PreemptInstruments",
+           "OUTAGE_BUCKETS", "SERVE_LATENCY_BUCKETS", "NOTICE_BUCKETS",
+           "TOKEN_LATENCY_BUCKETS"]
 
 #: outage-duration buckets: sub-second blips through multi-minute storms.
 #: The default latency buckets top out at 60 s — exactly where the park
@@ -35,6 +36,14 @@ NOTICE_BUCKETS = (0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 30.0, 45.0, 60.0, 120.0)
 #: cumulative buckets, so the resolution here bounds its signal quality.
 SERVE_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                          0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+#: per-token decode latency buckets: a healthy decode step runs in the
+#: 1-100 ms band (one single-token executable dispatch plus host-side
+#: batch assembly), and anything past 1 s means a stream stalled behind
+#: a compile or a rescale. Finer low-end resolution than the request
+#: buckets because the LM SLO is per *token*, not per request.
+TOKEN_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                         0.1, 0.25, 0.5, 1.0, 2.5)
 
 
 class WorkerInstruments:
@@ -210,6 +219,96 @@ class ServeInstruments:
             "AOT compile time per bucket executable (paid before the first "
             "request, never on the request path)",
             labelnames=("bucket",),
+        )
+
+
+class LMServeInstruments:
+    """The LM replica's sensor suite: token throughput (the headline
+    number), per-token latency (the LM SLO), stream lifecycle by outcome,
+    KV-block pressure (the admission currency), and prefill/decode batch
+    sizes (how full the two phase executables actually run). One scrape
+    answers "how fast is this replica decoding, and is KV memory the
+    bottleneck?"."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        r = registry if registry is not None else get_registry()
+        self.tokens = r.counter(
+            "edl_lm_tokens_total",
+            "tokens emitted, by phase (prefill = the prompt's first "
+            "generated token, decode = every subsequent one)",
+            labelnames=("phase",),  # prefill | decode
+        )
+        self.token_latency = r.histogram(
+            "edl_lm_token_latency_seconds",
+            "inter-token latency per emitted token (previous emit — or "
+            "admission, for the first token — to this emit); the LM "
+            "autoscaler's p99 source",
+            buckets=TOKEN_LATENCY_BUCKETS,
+        )
+        self.ttft = r.histogram(
+            "edl_lm_ttft_seconds",
+            "time to first token: admission to the prompt's first "
+            "generated token (queue wait + prefill dispatch)",
+            buckets=SERVE_LATENCY_BUCKETS,
+        )
+        self.streams = r.counter(
+            "edl_lm_streams_total",
+            "streams finished, by outcome (eos | length | rejected | "
+            "evicted | error); evicted streams resume elsewhere — the "
+            "router, not the replica, owns the zero-drop contract",
+            labelnames=("outcome",),
+        )
+        self.active_streams = r.gauge(
+            "edl_lm_active_streams",
+            "streams holding KV cache and decoding right now",
+        )
+        self.waiting_streams = r.gauge(
+            "edl_lm_waiting_streams",
+            "admitted streams queued for their prefill dispatch",
+        )
+        self.kv_blocks_used = r.gauge(
+            "edl_lm_kv_blocks_used",
+            "KV-cache pool blocks currently reserved by live streams",
+        )
+        self.kv_blocks_free = r.gauge(
+            "edl_lm_kv_blocks_free",
+            "KV-cache pool blocks on the freelist (the admission headroom)",
+        )
+        self.kv_occupancy = r.gauge(
+            "edl_lm_kv_occupancy",
+            "fraction of KV-cache pool blocks reserved (1.0 = admission "
+            "rejects everything until a stream retires)",
+        )
+        self.kv_fragmentation = r.gauge(
+            "edl_lm_kv_fragmentation",
+            "internal fragmentation: fraction of reserved KV token slots "
+            "never written (max_new_tokens budgets running past actual "
+            "generation lengths)",
+        )
+        self.prefill_batch = r.histogram(
+            "edl_lm_prefill_batch_size",
+            "real prompts per prefill dispatch (before padding to the "
+            "batch bucket)",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self.decode_batch = r.histogram(
+            "edl_lm_decode_batch_size",
+            "real streams per decode step dispatch (before padding); "
+            "persistently low means the pool is starved or the seq-bucket "
+            "ladder is splitting the batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64),
+        )
+        self.decode_steps = r.counter(
+            "edl_lm_decode_steps_total",
+            "decode-step executions, by (batch bucket, seq bucket) "
+            "executable — the LM analogue of the bucket hit-rate table",
+            labelnames=("bucket", "seq_bucket"),
+        )
+        self.compile_seconds = r.gauge(
+            "edl_lm_compile_seconds",
+            "AOT compile time per (phase, batch bucket, seq bucket) "
+            "executable (paid before the first request)",
+            labelnames=("phase", "bucket", "seq_bucket"),
         )
 
 

@@ -5,51 +5,91 @@ the load is — but training is only half of that story. This package
 serves what `runtime/export.py` publishes:
 
 - :mod:`edl_tpu.serving.batcher` — the pure bucket-ladder math under
-  continuous batching (pick/pad/split, numpy-only).
+  continuous batching (pick/pad/split, numpy-only), on TWO axes: batch
+  slots, and — for LM traffic — sequence-length capacity.
 - :mod:`edl_tpu.serving.worker` — :class:`ServingReplica`: AOT-compiles
   one predict executable per batch bucket before the first request (the
   PR 2 warm-compile contract — the jit dispatch cache stays empty), runs
   the continuous-batching dispatch loop, and hot-swaps model versions
   behind the exporter's atomic ``LATEST`` pointer with zero dropped
   requests.
-- :mod:`edl_tpu.serving.frontend` — ``POST /predict`` + the obs surface
-  (`/metrics`, `/healthz`, `/spans`) on one stdlib HTTP port.
-- :mod:`edl_tpu.serving.autoscale` — the SLO signal (p99 from scraped
-  histogram buckets, queue depth) the controller autoscaler scales
-  serving replicas on, instead of cluster utilization.
+- :mod:`edl_tpu.serving.lm` — :class:`LMServingReplica`: the LM-native
+  sibling. Decode-step continuous batching (batch membership changes per
+  token), prefill/decode phase separation (both phases AOT per (batch
+  bucket, seq bucket)), and paged-KV admission.
+- :mod:`edl_tpu.serving.kvcache` — :class:`BlockPool`: the paged
+  KV-cache block allocator; memory, not batch slots, is the LM tier's
+  admission currency.
+- :mod:`edl_tpu.serving.router` — :class:`Router`: health/affinity
+  routing over a mutable replica pool, with zero-drop stream migration
+  when the pool shrinks mid-decode.
+- :mod:`edl_tpu.serving.frontend` — ``POST /predict`` + ``POST
+  /generate`` + the obs surface (`/metrics`, `/healthz`, `/spans`) on one
+  stdlib HTTP port.
+- :mod:`edl_tpu.serving.autoscale` — the SLO signals the controller
+  autoscaler scales serving replicas on: request-latency p99 + queue
+  depth for the batch tier, per-token p99 + KV occupancy for the LM tier.
 
-``python -m edl_tpu.serving`` is the serve-smoke deploy gate: export an
-artifact, boot a replica, push requests through the real HTTP frontend,
-scrape `/metrics`, and assert the latency/queue families and the
-empty-dispatch-cache AOT contract. See doc/serving.md.
+``python -m edl_tpu.serving`` is the serve-smoke deploy gate (add ``lm``
+for the LM tier): export an artifact, boot a replica, push traffic
+through the real HTTP frontend, scrape `/metrics`, and assert the
+metric families and the empty-dispatch-cache AOT contract. See
+doc/serving.md.
 """
 
 from edl_tpu.serving.autoscale import (
+    LMServeSignal,
+    LMServingSLO,
     ServeSignal,
     ServingSLO,
+    aggregate_lm_signals,
     aggregate_signals,
+    desired_lm_replica_delta,
     desired_replica_delta,
     histogram_quantile,
+    scrape_lm_signal,
     scrape_serve_signal,
 )
 from edl_tpu.serving.batcher import (
+    SeqTooLongError,
     pad_batch,
+    pad_token_rows,
     pick_bucket,
+    pick_seq_bucket,
     plan_chunks,
     split_rows,
     validate_buckets,
 )
 from edl_tpu.serving.frontend import ServeRequestHandler, make_frontend
+from edl_tpu.serving.kvcache import (
+    BlockPool,
+    KVCacheConfig,
+    KVCacheExhaustedError,
+)
+from edl_tpu.serving.lm import LMServingConfig, LMServingReplica, LMStreamHandle
+from edl_tpu.serving.router import NoReplicaError, Router
 from edl_tpu.serving.worker import (
     SERVING_KV_PREFIX,
     ServeCompileError,
     ServeOverloadError,
     ServingConfig,
     ServingReplica,
+    probe_jit_cache,
 )
 
 __all__ = [
+    "BlockPool",
+    "KVCacheConfig",
+    "KVCacheExhaustedError",
+    "LMServeSignal",
+    "LMServingConfig",
+    "LMServingReplica",
+    "LMServingSLO",
+    "LMStreamHandle",
+    "NoReplicaError",
+    "Router",
     "SERVING_KV_PREFIX",
+    "SeqTooLongError",
     "ServeCompileError",
     "ServeOverloadError",
     "ServeRequestHandler",
@@ -57,13 +97,19 @@ __all__ = [
     "ServingConfig",
     "ServingReplica",
     "ServingSLO",
+    "aggregate_lm_signals",
     "aggregate_signals",
+    "desired_lm_replica_delta",
     "desired_replica_delta",
     "histogram_quantile",
     "make_frontend",
     "pad_batch",
+    "pad_token_rows",
     "pick_bucket",
+    "pick_seq_bucket",
     "plan_chunks",
+    "probe_jit_cache",
+    "scrape_lm_signal",
     "scrape_serve_signal",
     "split_rows",
     "validate_buckets",

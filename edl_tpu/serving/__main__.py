@@ -1,4 +1,5 @@
-"""The serve smoke: ``python -m edl_tpu.serving`` (``make serve-smoke``).
+"""The serve smokes: ``python -m edl_tpu.serving`` (``make serve-smoke``)
+and ``python -m edl_tpu.serving lm`` (``make serve-lm-smoke``).
 
 Boots the serving tier end to end the way a pod would see it: export a
 real artifact (versioned layout, atomic ``LATEST``), start a
@@ -12,8 +13,15 @@ real artifact (versioned layout, atomic ``LATEST``), start a
   first request and the jit dispatch cache is still empty,
 - a model-version swap landed mid-traffic with zero dropped requests.
 
-Exit 0 only when all of it holds — the deploy gate for the serving path,
-chained into ``make verify``.
+The ``lm`` mode does the same for the LM tier: export a small transformer,
+boot an :class:`LMServingReplica`, decode a prompt batch through ``POST
+/generate`` concurrently (continuous batching with per-token membership),
+then assert zero dropped streams, exact token accounting, the LM metric
+families, a fully-recycled KV block pool, and the empty-dispatch-cache
+AOT contract across BOTH phase executables.
+
+Exit 0 only when all of it holds — the deploy gates for the serving
+path, chained into ``make verify``.
 """
 
 from __future__ import annotations
@@ -32,17 +40,134 @@ REQUIRED_FAMILIES = (
     "edl_serve_model_swaps_total",
 )
 
+#: the LM tier's telemetry contract — the first two are the LM
+#: autoscaler's inputs, the KV families the router's affinity source.
+REQUIRED_LM_FAMILIES = (
+    "edl_lm_token_latency_seconds",
+    "edl_lm_kv_occupancy",
+    "edl_lm_tokens_total",
+    "edl_lm_kv_blocks_free",
+    "edl_lm_prefill_batch_size",
+    "edl_lm_decode_batch_size",
+    "edl_lm_decode_steps_total",
+)
+
 N_REQUESTS = 48
+N_STREAMS = 12
+MAX_NEW_TOKENS = 8
 
 
-def main() -> int:
-    # Hermetic CPU backend BEFORE jax imports: the smoke must run anywhere.
+def _hermetic_cpu() -> None:
+    # Hermetic CPU backend BEFORE jax imports: the smokes must run anywhere.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+
+
+def main_lm() -> int:
+    _hermetic_cpu()
+
+    import json
+    import tempfile
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import numpy as np
+
+    from edl_tpu.models import transformer
+    from edl_tpu.obs.http import scrape_metrics
+    from edl_tpu.obs.metrics import parse_prometheus
+    from edl_tpu.runtime.export import _serving_mesh, save_inference_model
+    from edl_tpu.serving import LMServingConfig, LMServingReplica
+
+    model_kw = dict(vocab_size=64, d_model=32, n_layers=2, n_heads=2,
+                    d_ff=64, seq_len=64, flash=False)
+    model = transformer.make_model(**model_kw)
+    mesh = _serving_mesh(model)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+
+    with tempfile.TemporaryDirectory() as td:
+        art_dir = os.path.join(td, "artifact")
+        save_inference_model(art_dir, "transformer", params,
+                             config=model_kw, step=100)
+        replica = LMServingReplica(LMServingConfig(
+            model_dir=art_dir, batch_buckets=(1, 4), seq_buckets=(16, 32),
+            kv_blocks=32, kv_block_tokens=8, port=0, name="smoke-lm",
+        )).start()
+        try:
+            cache0 = replica.jit_cache_size()
+            rng = np.random.default_rng(0)
+
+            def one_stream(i: int):
+                body = json.dumps({
+                    "prompt": rng.integers(1, 60, size=3 + i % 9).tolist(),
+                    "max_new_tokens": MAX_NEW_TOKENS,
+                }).encode()
+                req = urllib.request.Request(
+                    replica.url + "/generate", data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())
+
+            # concurrent submission: streams join and leave the decode
+            # batch at step boundaries, not request boundaries
+            with ThreadPoolExecutor(max_workers=6) as pool:
+                results = list(pool.map(one_stream, range(N_STREAMS)))
+            status = replica.status()
+            text = scrape_metrics(replica.url)
+            families = parse_prometheus(text)
+        finally:
+            replica.stop()
+
+    failures = []
+    short = [r for r in results
+             if len(r["tokens"]) != MAX_NEW_TOKENS
+             or r["finish_reason"] != "length"]
+    if short:
+        failures.append(f"{len(short)}/{N_STREAMS} streams returned wrong "
+                        f"token counts: {short[:2]}")
+    missing = [f for f in REQUIRED_LM_FAMILIES if f not in families]
+    if missing:
+        failures.append(f"missing LM metric families: {missing}")
+    cache_now = replica.jit_cache_size()
+    if cache0 not in (0, None) or cache_now not in (0, None):
+        failures.append(
+            f"jit dispatch cache not empty (start={cache0}, end={cache_now})"
+            " — a prefill/decode executable was dispatched through jit, "
+            "not AOT"
+        )
+    if status["completed"] != N_STREAMS or status["rejected"]:
+        failures.append(f"dropped/rejected streams: {status}")
+    kv = status["kv"]
+    if kv["used_blocks"] != 0 or kv["free_blocks"] != kv["n_blocks"]:
+        failures.append(f"KV block pool leaked: {kv}")
+    expected = N_STREAMS * MAX_NEW_TOKENS
+    if status["tokens_generated"] != expected:
+        failures.append(f"token accounting off: generated "
+                        f"{status['tokens_generated']}, expected {expected}")
+
+    if failures:
+        print("serve-lm-smoke FAILED:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(
+        f"serve-lm-smoke OK: {N_STREAMS} streams x {MAX_NEW_TOKENS} tokens "
+        f"over HTTP /generate, 0 dropped, KV pool fully recycled "
+        f"(peak {kv['peak_blocks_used']}/{kv['n_blocks']} blocks), "
+        f"jit dispatch cache empty across prefill+decode, "
+        f"{len(REQUIRED_LM_FAMILIES)} required families present"
+    )
+    return 0
+
+
+def main() -> int:
+    _hermetic_cpu()
 
     import json
     import tempfile
@@ -140,4 +265,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main_lm() if "lm" in sys.argv[1:] else main())

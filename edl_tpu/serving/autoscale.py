@@ -21,12 +21,16 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["ServeSignal", "ServingSLO", "histogram_quantile",
-           "scrape_serve_signal", "aggregate_signals", "desired_replica_delta"]
+           "scrape_serve_signal", "aggregate_signals", "desired_replica_delta",
+           "LMServeSignal", "LMServingSLO", "scrape_lm_signal",
+           "aggregate_lm_signals", "desired_lm_replica_delta"]
 
 log = logging.getLogger("edl_tpu.serving.autoscale")
 
 _LATENCY_FAMILY = "edl_serve_request_latency_seconds"
 _QUEUE_FAMILY = "edl_serve_queue_depth"
+_TOKEN_LATENCY_FAMILY = "edl_lm_token_latency_seconds"
+_KV_OCCUPANCY_FAMILY = "edl_lm_kv_occupancy"
 
 
 @dataclass
@@ -138,6 +142,98 @@ def aggregate_signals(
     p99 = histogram_quantile(buckets, 0.99)
     queue = sum(sig.queue_depth for sig in signals) / len(signals)
     return p99, queue
+
+
+# -- the LM tier's signal ------------------------------------------------------
+#
+# An LM replica's user-felt load is per-TOKEN latency (a stream is hundreds
+# of device steps; request latency just measures generation length), and
+# its capacity ceiling is KV-cache memory, not queue slots. So the LM
+# scaling signal pairs the `edl_lm_token_latency_seconds` p99 with the
+# `edl_lm_kv_occupancy` gauge — and occupancy aggregates by MAX, not mean:
+# streams cannot split across replicas, so one full pool rejects real
+# traffic no matter how empty its neighbors are.
+
+
+@dataclass
+class LMServeSignal:
+    """One LM replica's scraped load state."""
+
+    #: cumulative (le_upper_bound, count) pairs, +inf last
+    token_latency_buckets: List[Tuple[float, float]]
+    token_count: float
+    kv_occupancy: float
+
+
+@dataclass
+class LMServingSLO:
+    """The LM tier's scaling contract: interactive decode targets ~10
+    tokens/s/stream felt as <100 ms between tokens; KV headroom keeps
+    admission from 429ing bursts."""
+
+    p99_token_seconds: float = 0.1
+    max_kv_occupancy: float = 0.85
+    shrink_frac: float = 0.3
+    shrink_occupancy_frac: float = 0.4
+
+
+def scrape_lm_signal(url: str, timeout: float = 2.0) -> Optional[LMServeSignal]:
+    """Scrape one LM replica's `/metrics` into an :class:`LMServeSignal`;
+    None when unreachable or not yet exporting the LM families."""
+    from edl_tpu.obs.http import scrape_metrics
+    from edl_tpu.obs.metrics import parse_prometheus
+
+    try:
+        families = parse_prometheus(scrape_metrics(url, timeout=timeout))
+    except (OSError, ValueError) as e:
+        log.debug("LM serve scrape of %s failed: %s", url, e)
+        return None
+    latency = families.get(_TOKEN_LATENCY_FAMILY)
+    occupancy = families.get(_KV_OCCUPANCY_FAMILY)
+    if latency is None or occupancy is None:
+        return None
+    buckets = _parse_bucket_samples(latency["samples"], _TOKEN_LATENCY_FAMILY)
+    count = latency["samples"].get(_TOKEN_LATENCY_FAMILY + "_count", 0.0)
+    occ = occupancy["samples"].get(_KV_OCCUPANCY_FAMILY, 0.0)
+    return LMServeSignal(token_latency_buckets=buckets, token_count=count,
+                         kv_occupancy=occ)
+
+
+def aggregate_lm_signals(
+    signals: Sequence[LMServeSignal],
+) -> Optional[Tuple[Optional[float], float]]:
+    """(per-token p99 across ALL replicas' tokens, MAX KV occupancy)."""
+    if not signals:
+        return None
+    summed: Dict[float, float] = {}
+    for sig in signals:
+        for bound, count in sig.token_latency_buckets:
+            summed[bound] = summed.get(bound, 0.0) + count
+    buckets = sorted(summed.items())
+    p99 = histogram_quantile(buckets, 0.99)
+    occupancy = max(sig.kv_occupancy for sig in signals)
+    return p99, occupancy
+
+
+def desired_lm_replica_delta(
+    signals: Sequence[LMServeSignal],
+    slo: LMServingSLO,
+) -> int:
+    """+1 / 0 / -1 LM replica from the aggregated signal, same hysteresis
+    discipline as :func:`desired_replica_delta`. A shrink hands the
+    doomed replica's streams to the router's migration path — the delta
+    here only says the pool is oversized, never which streams move."""
+    agg = aggregate_lm_signals(signals)
+    if agg is None:
+        return 0  # no scrapes landed: hold, never flap blind
+    p99, occupancy = agg
+    if (p99 is not None and p99 > slo.p99_token_seconds) \
+            or occupancy > slo.max_kv_occupancy:
+        return 1
+    if (p99 is None or p99 < slo.shrink_frac * slo.p99_token_seconds) \
+            and occupancy < slo.shrink_occupancy_frac * slo.max_kv_occupancy:
+        return -1
+    return 0
 
 
 def desired_replica_delta(

@@ -7,7 +7,17 @@ fix — XLA serving, batching on TPU pods — is a small ladder of fixed
 bucket sizes: coalesce queued requests, pad up to the smallest bucket
 that fits, and dispatch an executable compiled once per bucket. This
 module holds the ladder math and the pad/split plumbing; it is numpy-pure
-(no jax, no threads) so every edge case is unit-testable in microseconds.
+(no jax imports at module scope, no threads) so every edge case is
+unit-testable in microseconds.
+
+The LM tier adds a SECOND bucket axis: sequence length. A decode or
+prefill executable is specialized to (batch slots, token capacity), so
+autoregressive requests bucket twice — batch slot count by the ladder
+above, token capacity by :func:`pick_seq_bucket`. Unlike the batch axis
+(where the dispatcher chunks overflow via :func:`plan_chunks`), sequence
+overflow is a hard admission error: a stream longer than the largest
+seq bucket can never fit any compiled executable, so it is rejected with
+the typed :class:`SeqTooLongError` before any memory is allocated.
 """
 
 from __future__ import annotations
@@ -16,7 +26,15 @@ import numpy as np
 from typing import Dict, List, Sequence, Tuple
 
 __all__ = ["pick_bucket", "plan_chunks", "pad_batch", "split_rows",
-           "validate_buckets"]
+           "validate_buckets", "pick_seq_bucket", "pad_token_rows",
+           "SeqTooLongError"]
+
+
+class SeqTooLongError(ValueError):
+    """Request needs more token capacity than the largest seq bucket —
+    no compiled (bucket, seq-bucket) executable can ever run it, so the
+    admission path rejects it synchronously (HTTP 400, not 429: retrying
+    the same request can never succeed)."""
 
 
 def validate_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
@@ -70,25 +88,91 @@ def pad_batch(
         raise ValueError(f"{len(rows)} rows exceed bucket {bucket}")
     out: Dict[str, np.ndarray] = {}
     for key, (shape, dtype) in feature_avals.items():
-        arr = np.zeros((bucket,) + tuple(shape), dtype=dtype)
-        for i, row in enumerate(rows):
-            if key not in row:
-                raise KeyError(f"request {i} missing feature {key!r}")
-            value = np.asarray(row[key], dtype=dtype)
-            if value.shape != tuple(shape):
-                raise ValueError(
-                    f"feature {key!r} of request {i} has shape "
-                    f"{value.shape}, expected {tuple(shape)}"
-                )
-            arr[i] = value
+        shape = tuple(shape)
+        try:
+            # Fast path (the per-batch hot loop): submit() already coerced
+            # every row, so one stack + one zero-filled tail covers the
+            # whole bucket without a per-row Python loop.
+            stacked = np.stack([row[key] for row in rows]).astype(
+                dtype, copy=False
+            )
+            if stacked.shape != (len(rows),) + shape:
+                raise ValueError  # shape drift: diagnose per row below
+            arr = np.zeros((bucket,) + shape, dtype=dtype)
+            arr[: len(rows)] = stacked
+        except (KeyError, ValueError, TypeError):
+            # Slow path only on mismatch: re-walk row by row to raise the
+            # error that names the offending request and feature.
+            arr = np.zeros((bucket,) + shape, dtype=dtype)
+            for i, row in enumerate(rows):
+                if key not in row:
+                    raise KeyError(f"request {i} missing feature {key!r}")
+                value = np.asarray(row[key], dtype=dtype)
+                if value.shape != shape:
+                    raise ValueError(
+                        f"feature {key!r} of request {i} has shape "
+                        f"{value.shape}, expected {shape}"
+                    )
+                arr[i] = value
         out[key] = arr
     return out
 
 
 def split_rows(outputs, n: int) -> List:
     """The first ``n`` rows of a (possibly pytree) batched output, one
-    entry per real request — the padded tail rows are dropped."""
+    entry per real request — the padded tail rows are dropped.
+
+    One device-to-host transfer for the whole tree, then host-side row
+    slicing: this sits on the per-batch hot path, and a per-row tree_map
+    over device arrays costs one transfer per (row, leaf) instead."""
     import jax
 
-    return [jax.tree_util.tree_map(lambda a: a[i], outputs)
-            for i in range(n)]
+    host = jax.device_get(outputs)
+    return [jax.tree_util.tree_map(lambda a: a[i], host) for i in range(n)]
+
+
+# -- the sequence-length bucket axis (LM serving) ------------------------------
+
+
+def pick_seq_bucket(tokens: int, seq_buckets: Sequence[int]) -> int:
+    """Smallest seq bucket with capacity for ``tokens``; raises
+    :class:`SeqTooLongError` when even the largest cannot hold it.
+
+    Unlike :func:`pick_bucket` this never clamps: a batch overflow splits
+    into more chunks, but a sequence cannot be split across executables —
+    admission must reject what the ladder cannot carry."""
+    if tokens <= 0:
+        raise ValueError(f"token count must be positive, got {tokens}")
+    for b in seq_buckets:
+        if tokens <= b:
+            return b
+    raise SeqTooLongError(
+        f"request needs {tokens} token slots but the largest seq bucket "
+        f"is {seq_buckets[-1]}"
+    )
+
+
+def pad_token_rows(
+    rows: List[np.ndarray], bucket: int, seq_bucket: int,
+    pad_id: int = 0,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(tokens, lengths) for a prefill dispatch: ``rows`` are 1-D int
+    token-id arrays of varying length, right-padded with ``pad_id`` to
+    ``seq_bucket`` and stacked into ``bucket`` slots (tail slots all-pad).
+
+    Returns int32 arrays shaped (bucket, seq_bucket) and (bucket,).
+    Rows longer than ``seq_bucket`` raise :class:`SeqTooLongError` — the
+    caller's admission check should have bucketed them already."""
+    if len(rows) > bucket:
+        raise ValueError(f"{len(rows)} rows exceed bucket {bucket}")
+    tokens = np.full((bucket, seq_bucket), pad_id, dtype=np.int32)
+    lengths = np.zeros((bucket,), dtype=np.int32)
+    for i, row in enumerate(rows):
+        ids = np.asarray(row, dtype=np.int32).reshape(-1)
+        if ids.size > seq_bucket:
+            raise SeqTooLongError(
+                f"prompt of {ids.size} tokens exceeds seq bucket {seq_bucket}"
+            )
+        tokens[i, : ids.size] = ids
+        lengths[i] = ids.size
+    return tokens, lengths

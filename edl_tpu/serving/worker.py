@@ -42,7 +42,7 @@ from edl_tpu.serving.batcher import (pad_batch, pick_bucket, split_rows,
                                      validate_buckets)
 
 __all__ = ["ServingConfig", "ServingReplica", "ServeOverloadError",
-           "ServeCompileError", "SERVING_KV_PREFIX"]
+           "ServeCompileError", "SERVING_KV_PREFIX", "probe_jit_cache"]
 
 log = logging.getLogger("edl_tpu.serving.worker")
 
@@ -50,6 +50,24 @@ log = logging.getLogger("edl_tpu.serving.worker")
 #: the FT-policy state: `edl/ft_policy/<member>`); `edl-tpu status` joins
 #: members() against these keys.
 SERVING_KV_PREFIX = "edl/serving/"
+
+
+def probe_jit_cache(*jit_fns) -> Optional[int]:
+    """Total compiled-program count across the given jitted functions'
+    dispatch caches, via the private ``_cache_size`` probe; None when any
+    probe is unavailable. This is the teeth of the AOT contract: a tier
+    that lowers from avals and dispatches ``Compiled`` objects directly
+    keeps every one of these at 0 no matter how much traffic it served."""
+    total = 0
+    for fn in jit_fns:
+        probe = getattr(fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            total += int(probe())
+        except TypeError:
+            return None
+    return total
 
 
 class ServeOverloadError(RuntimeError):
@@ -228,6 +246,14 @@ class ServingReplica:
     def url(self) -> Optional[str]:
         return self._server.url if self._server is not None else None
 
+    @property
+    def started(self) -> bool:
+        """True between a successful ``start()`` and ``stop()`` — the
+        router's health predicate (an unstarted or stopped replica takes
+        no traffic)."""
+        with self._lock:
+            return self._started
+
     # -- request path ----------------------------------------------------------
 
     def submit(self, features: Dict[str, Any]) -> Future:
@@ -361,13 +387,7 @@ class ServingReplica:
         the private probe is unavailable). The AOT contract — every bucket
         pre-compiled, ``Compiled`` dispatched directly — keeps this at 0
         no matter how many requests have been served."""
-        probe = getattr(self._jit_predict, "_cache_size", None)
-        if probe is None:
-            return None
-        try:
-            return int(probe())
-        except TypeError:
-            return None
+        return probe_jit_cache(self._jit_predict)
 
     # -- dispatch loop ---------------------------------------------------------
 
@@ -505,6 +525,9 @@ class ServingReplica:
         with self._lock:
             return {
                 "name": self.config.name,
+                "kind": "batch",  # fixed-shape request/response; LM
+                                  # replicas publish kind="lm" to the
+                                  # same KV slot
                 "model_step": self._model_step,
                 "version": self._version[2] if self._version else None,
                 "queue_depth": self._queue.qsize(),

@@ -560,3 +560,55 @@ def test_cli_status_renders_ft_policy_section(capsys):
         assert rc == 0
         assert payload["ft_policy"]["trainer-0"]["mode"] == "park"
         assert payload["ft_policy"]["trainer-0"]["threshold"] == 4.2
+
+
+def test_cli_status_renders_lm_serving_section(capsys):
+    """LM replicas publish kind="lm" blobs under edl/serving/<member>;
+    `edl-tpu status` renders the decode-native numbers (streams, tokens/s,
+    KV block pool) instead of the batch tier's queue/bucket line, and
+    --json carries the blob through verbatim."""
+    from edl_tpu.cli import main
+    from edl_tpu.coordinator import CoordinatorServer
+
+    lm_blob = {
+        "name": "lm-0", "kind": "lm", "model_step": 100, "version": 3,
+        "active_streams": 2, "waiting_streams": 0, "completed": 7,
+        "rejected": 1, "evicted": 0, "tokens_generated": 56,
+        "tokens_per_s": 12.5, "batch_buckets": [1, 4],
+        "seq_buckets": [64, 128],
+        "kv": {"n_blocks": 64, "block_tokens": 16, "used_blocks": 9,
+               "free_blocks": 55, "peak_blocks_used": 12, "streams": 2,
+               "occupancy": 0.1406, "fragmentation": 0.42},
+    }
+    batch_blob = {
+        "name": "serve-0", "kind": "batch", "model_step": 200, "version": 5,
+        "queue_depth": 0, "bucket_hits": {"4": 3}, "last_swap_step": 100,
+        "completed": 12,
+    }
+    with CoordinatorServer() as server:
+        w = server.client("lm-0")
+        w.register()
+        w.kv_put("edl/serving/lm-0", json.dumps(lm_blob))
+        w2 = server.client("serve-0")
+        w2.register()
+        w2.kv_put("edl/serving/serve-0", json.dumps(batch_blob))
+
+        rc = main(["status", "--port", str(server.port)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serving replicas:" in out
+        # the LM line renders stream/token/KV state...
+        assert "kind=lm" in out
+        assert "tokens/s=12.5" in out
+        assert "kv_blocks=9/64" in out and "frag=0.42" in out
+        assert "streams=2" in out
+        # ...while the batch replica keeps its queue/bucket rendering
+        assert "queue=0" in out and "buckets=4:3" in out
+
+        rc = main(["status", "--port", str(server.port), "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert payload["serving"]["lm-0"]["kind"] == "lm"
+        assert payload["serving"]["lm-0"]["kv"]["free_blocks"] == 55
+        assert payload["serving"]["lm-0"]["tokens_generated"] == 56
+        assert payload["serving"]["serve-0"]["kind"] == "batch"

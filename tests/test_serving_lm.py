@@ -1,0 +1,450 @@
+"""LM serving tier: seq-bucket ladder, paged KV-cache admission,
+decode-step continuous batching, prefill/decode AOT, router migration.
+
+The acceptance contract under test (ISSUE 20): batch membership changes
+per token (join at a decode-step boundary, leave on EOS/max-tokens),
+memory — not batch slots — is the admission currency (block-pool
+exhaustion is a typed 429, seq-ladder overflow a typed 400), and the
+``jit_cache_size() == 0`` AOT contract survives LM traffic across BOTH
+phase executables.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from edl_tpu.models import transformer
+from edl_tpu.obs.metrics import MetricsRegistry
+from edl_tpu.runtime.export import _serving_mesh, save_inference_model
+from edl_tpu.serving import (
+    BlockPool,
+    KVCacheConfig,
+    KVCacheExhaustedError,
+    LMServeSignal,
+    LMServingConfig,
+    LMServingReplica,
+    LMServingSLO,
+    NoReplicaError,
+    Router,
+    SeqTooLongError,
+    aggregate_lm_signals,
+    desired_lm_replica_delta,
+    pad_batch,
+    pad_token_rows,
+    pick_seq_bucket,
+)
+
+MODEL_KW = dict(vocab_size=61, d_model=16, n_layers=2, n_heads=2, d_ff=32,
+                seq_len=64, flash=False)
+
+
+@pytest.fixture(scope="module")
+def lm_artifact(tmp_path_factory):
+    directory = str(tmp_path_factory.mktemp("lm_art"))
+    model = transformer.make_model(**MODEL_KW)
+    mesh = _serving_mesh(model)
+    params = model.init(jax.random.PRNGKey(0), mesh)
+    save_inference_model(directory, "transformer", params,
+                         config=MODEL_KW, step=100)
+    return directory
+
+
+@pytest.fixture
+def lm_replica_factory(lm_artifact):
+    """Builds started LM replicas against the module artifact; stops all."""
+    live = []
+
+    def make(**overrides):
+        # batch_buckets=(1,) keeps the AOT compile count down on the
+        # shared-artifact tests; tests that exercise batched decode
+        # membership override to a real ladder.
+        kwargs = dict(model_dir=lm_artifact, batch_buckets=(1,),
+                      seq_buckets=(16, 32), kv_blocks=16, kv_block_tokens=8,
+                      default_max_new_tokens=4,
+                      name=f"lm-t{len(live)}")
+        kwargs.update(overrides)
+        replica = LMServingReplica(LMServingConfig(**kwargs),
+                                   registry=MetricsRegistry())
+        live.append(replica)
+        return replica.start()
+
+    yield make
+    for replica in live:
+        replica.stop()
+
+
+# -- seq-bucket ladder units ---------------------------------------------------
+
+
+def test_pick_seq_bucket_picks_smallest_fit():
+    assert pick_seq_bucket(1, (16, 32)) == 16
+    assert pick_seq_bucket(16, (16, 32)) == 16
+    assert pick_seq_bucket(17, (16, 32)) == 32
+    assert pick_seq_bucket(32, (16, 32)) == 32
+
+
+def test_pick_seq_bucket_overflow_is_typed_rejection():
+    """Unlike the batch axis (overflow splits into chunks), a sequence
+    cannot split across executables — past the ladder is a hard typed
+    reject, and the type subclasses ValueError for HTTP 400 mapping."""
+    with pytest.raises(SeqTooLongError):
+        pick_seq_bucket(33, (16, 32))
+    assert issubclass(SeqTooLongError, ValueError)
+    with pytest.raises(ValueError):
+        pick_seq_bucket(0, (16, 32))
+
+
+def test_pad_token_rows_pads_and_measures():
+    tokens, lengths = pad_token_rows(
+        [np.array([5, 6, 7]), np.array([9])], bucket=4, seq_bucket=8
+    )
+    assert tokens.shape == (4, 8) and tokens.dtype == np.int32
+    assert lengths.tolist() == [3, 1, 0, 0]
+    assert tokens[0, :3].tolist() == [5, 6, 7]
+    assert tokens[0, 3:].tolist() == [0] * 5
+    assert tokens[2].tolist() == [0] * 8  # dead tail slot
+
+
+def test_pad_token_rows_rejects_overflow():
+    with pytest.raises(SeqTooLongError):
+        pad_token_rows([np.arange(9)], bucket=1, seq_bucket=8)
+    with pytest.raises(ValueError):
+        pad_token_rows([np.array([1])] * 3, bucket=2, seq_bucket=8)
+
+
+def test_pad_batch_fast_path_matches_per_row_semantics():
+    avals = {"x": ((3,), np.dtype(np.float32))}
+    rows = [{"x": np.full(3, float(i), np.float32)} for i in range(2)]
+    out = pad_batch(rows, 4, avals)
+    assert out["x"].shape == (4, 3)
+    assert out["x"][1].tolist() == [1.0, 1.0, 1.0]
+    assert out["x"][2:].sum() == 0.0  # zero-padded tail
+
+
+def test_pad_batch_mismatch_still_names_the_offender():
+    """The np.stack fast path must fall back to the per-row walk that
+    raises the diagnostic naming the bad request and feature."""
+    avals = {"x": ((3,), np.dtype(np.float32))}
+    good = {"x": np.zeros(3, np.float32)}
+    with pytest.raises(ValueError, match="request 1"):
+        pad_batch([good, {"x": np.zeros(2, np.float32)}], 4, avals)
+    with pytest.raises(KeyError, match="request 1"):
+        pad_batch([good, {"y": np.zeros(3, np.float32)}], 4, avals)
+
+
+# -- paged KV-cache allocator --------------------------------------------------
+
+
+def test_block_pool_reserves_ceil_blocks():
+    pool = BlockPool(KVCacheConfig(n_blocks=8, block_tokens=4))
+    assert pool.config.blocks_for(1) == 1
+    assert pool.config.blocks_for(4) == 1
+    assert pool.config.blocks_for(5) == 2
+    table = pool.reserve("s1", 9)  # 3 blocks
+    assert len(table) == 3
+    assert pool.used_blocks() == 3 and pool.free_blocks() == 5
+
+
+def test_block_pool_exhaustion_is_atomic():
+    """A reservation the freelist cannot cover raises without claiming
+    anything — no partial claims to unwind, no leaked blocks."""
+    pool = BlockPool(KVCacheConfig(n_blocks=4, block_tokens=4))
+    pool.reserve("s1", 12)  # 3 of 4 blocks
+    with pytest.raises(KVCacheExhaustedError):
+        pool.reserve("s2", 8)  # needs 2, only 1 free
+    assert pool.free_blocks() == 1  # the failed reserve claimed nothing
+    pool.reserve("s3", 4)  # the remaining block still works
+
+
+def test_block_pool_release_recycles_and_is_idempotent():
+    pool = BlockPool(KVCacheConfig(n_blocks=4, block_tokens=4))
+    first = pool.reserve("s1", 16)
+    assert pool.release("s1") == 4
+    assert pool.release("s1") == 0  # double-free is a no-op
+    assert pool.free_blocks() == 4
+    # freelist recycling: the same physical blocks come back out
+    assert sorted(pool.reserve("s2", 16)) == sorted(first)
+    with pytest.raises(ValueError):
+        pool.reserve("s2", 4)  # duplicate stream id
+
+
+def test_block_pool_fragmentation_tracks_unwritten_budget():
+    pool = BlockPool(KVCacheConfig(n_blocks=8, block_tokens=4))
+    pool.reserve("s1", 16)  # 4 blocks = 16 token slots
+    assert pool.fragmentation() == 1.0  # nothing written yet
+    pool.note_tokens("s1", 8)
+    assert pool.fragmentation() == pytest.approx(0.5)
+    stats = pool.stats()
+    assert stats["reserved_tokens"] == 16 and stats["written_tokens"] == 8
+    assert stats["occupancy"] == pytest.approx(0.5)
+    pool.release("s1")
+    assert pool.fragmentation() == 0.0
+    pool.note_tokens("s1", 99)  # racing update after release: no-op
+    assert pool.stats()["streams"] == 0
+
+
+def test_block_pool_reports_bytes_when_sized():
+    pool = BlockPool(KVCacheConfig(n_blocks=4, block_tokens=4,
+                                   bytes_per_token=128))
+    pool.reserve("s1", 5)  # 2 blocks = 8 token slots
+    assert pool.stats()["used_bytes"] == 8 * 128
+
+
+# -- LM autoscale signal -------------------------------------------------------
+
+
+def _lm_signal(p99_band, count, occupancy):
+    buckets = [(0.01, 0.0), (0.1, 0.0), (float("inf"), 0.0)]
+    buckets = [(b, count if b >= p99_band else 0.0) for b, _ in buckets]
+    return LMServeSignal(token_latency_buckets=buckets, token_count=count,
+                         kv_occupancy=occupancy)
+
+
+def test_lm_occupancy_aggregates_by_max_not_mean():
+    """One full pool rejects real traffic no matter how empty its
+    neighbors are — streams cannot split across replicas."""
+    sig_full = _lm_signal(0.01, 100, 0.95)
+    sig_idle = _lm_signal(0.01, 100, 0.05)
+    _, occupancy = aggregate_lm_signals([sig_full, sig_idle])
+    assert occupancy == 0.95
+
+
+def test_lm_delta_grows_on_kv_pressure_and_shrinks_with_hysteresis():
+    slo = LMServingSLO(p99_token_seconds=0.1, max_kv_occupancy=0.85)
+    assert desired_lm_replica_delta([_lm_signal(0.01, 100, 0.95)], slo) == 1
+    assert desired_lm_replica_delta([_lm_signal(0.01, 100, 0.1)], slo) == -1
+    # in the hysteresis band: hold
+    assert desired_lm_replica_delta([_lm_signal(0.01, 100, 0.5)], slo) == 0
+    assert desired_lm_replica_delta([], slo) == 0
+
+
+# -- the decode engine ---------------------------------------------------------
+
+
+def test_lm_replica_aot_contract_and_exact_token_accounting(
+        lm_replica_factory):
+    replica = lm_replica_factory(batch_buckets=(1, 2))
+    assert replica.jit_cache_size() == 0
+    rng = np.random.default_rng(0)
+    handles = [replica.submit(rng.integers(1, 60, size=n), max_new_tokens=5)
+               for n in (3, 7, 12)]
+    results = [h.result(timeout=60) for h in handles]
+    for r in results:
+        assert len(r["tokens"]) == 5
+        assert r["finish_reason"] == "length"
+        assert r["model_step"] == 100
+    # BOTH phase jits' dispatch caches still empty: prefill and decode
+    # only ever dispatched pre-compiled executables
+    assert replica.jit_cache_size() == 0
+    status = replica.status()
+    assert status["kind"] == "lm"
+    assert status["completed"] == 3
+    assert status["tokens_generated"] == 15
+    assert status["kv"]["used_blocks"] == 0  # every reservation recycled
+
+
+def test_lm_decode_matches_incremental_prefill_reference(lm_replica_factory):
+    """The engine's KV-cache decode must emit exactly the tokens a naive
+    re-prefill-per-token loop would — the cache is an optimization, not a
+    different model."""
+    replica = lm_replica_factory()
+    prompt = np.asarray([7, 11, 13, 17, 19], dtype=np.int32)
+    out = replica.generate(prompt, max_new_tokens=4)
+
+    step_fn = jax.jit(transformer.make_prefill_step(
+        transformer.TransformerConfig(**MODEL_KW)))
+    seq, reference = list(prompt), []
+    for _ in range(4):
+        tokens = np.zeros((1, 16), np.int32)
+        tokens[0, :len(seq)] = seq
+        nxt, _, _ = step_fn(replica._art.params, tokens,
+                            np.array([len(seq)], np.int32))
+        reference.append(int(nxt[0]))
+        seq.append(int(nxt[0]))
+    assert out["tokens"] == reference
+
+
+def test_eos_on_first_decode_step(lm_replica_factory):
+    """A stream whose very first generated token is EOS retires at the
+    prefill boundary: one token, finish_reason eos, blocks recycled."""
+    replica = lm_replica_factory()
+    prompt = np.asarray([3, 5, 8], dtype=np.int32)
+    probe = replica.generate(prompt, max_new_tokens=1)
+    first = probe["tokens"][0]
+    out = replica.generate(prompt, max_new_tokens=6, eos_id=first)
+    assert out["tokens"] == [first]
+    assert out["finish_reason"] == "eos"
+    assert replica.status()["kv"]["used_blocks"] == 0
+
+
+def test_join_and_leave_on_the_same_step(lm_replica_factory):
+    """Per-token membership: streams with budgets 1/2/3 admitted together
+    — the budget-1 stream leaves at the prefill boundary exactly as the
+    others join the decode batch; everyone's accounting stays exact."""
+    replica = lm_replica_factory(batch_buckets=(1, 2))
+    prompt = np.asarray([2, 4, 6], dtype=np.int32)
+    handles = [replica.submit(prompt, max_new_tokens=budget)
+               for budget in (1, 2, 3)]
+    results = [h.result(timeout=60) for h in handles]
+    assert [len(r["tokens"]) for r in results] == [1, 2, 3]
+    # same prompt => identical greedy prefixes; the short streams are
+    # prefixes of the long one (leaving early never perturbs neighbors)
+    assert results[2]["tokens"][:1] == results[0]["tokens"]
+    assert results[2]["tokens"][:2] == results[1]["tokens"]
+    status = replica.status()
+    assert status["completed"] == 3
+    assert status["tokens_generated"] == 6
+    assert status["active_streams"] == 0
+
+
+def test_admission_rejections_are_typed(lm_replica_factory):
+    replica = lm_replica_factory()
+    # seq-ladder overflow: prompt + budget > largest bucket (32)
+    with pytest.raises(SeqTooLongError):
+        replica.submit(np.arange(1, 30), max_new_tokens=10)
+    # pool exhaustion: 16 blocks x 8 tokens = 128 slots; four 32-budget
+    # streams (4 blocks each) drain the freelist
+    blockers = [replica.submit([1, 2], max_new_tokens=26) for _ in range(4)]
+    with pytest.raises(KVCacheExhaustedError):
+        replica.submit([1, 2], max_new_tokens=26)
+    for h in blockers:
+        h.result(timeout=120)
+    # retirement recycled the blocks: admission works again
+    replica.generate([1, 2], max_new_tokens=26)
+    assert replica.status()["rejected"] == 2
+
+
+def test_http_generate_maps_typed_errors(lm_replica_factory):
+    replica = lm_replica_factory(port=0, kv_blocks=4, kv_block_tokens=8)
+
+    def post(body):
+        req = urllib.request.Request(
+            replica.url + "/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, None
+
+    status, reply = post({"prompt": [5, 9, 11], "max_new_tokens": 3})
+    assert status == 200
+    assert len(reply["tokens"]) == 3
+    assert reply["finish_reason"] == "length"
+    # 400: the seq ladder can never hold it — retrying cannot help
+    status, _ = post({"prompt": list(range(1, 30)), "max_new_tokens": 20})
+    assert status == 400
+    # 429: pool exhausted — retry elsewhere/later CAN help
+    blocker = replica.submit([1, 2], max_new_tokens=28)  # 30 of 32 slots
+    status, _ = post({"prompt": [1, 2, 3], "max_new_tokens": 10})
+    assert status == 429
+    blocker.result(timeout=120)
+    status, _ = post({"prompt": "not-a-list"})
+    assert status == 400
+
+
+def test_replica_drain_on_stop(lm_artifact):
+    replica = LMServingReplica(LMServingConfig(
+        model_dir=lm_artifact, batch_buckets=(1,), seq_buckets=(16, 32),
+        kv_blocks=16, kv_block_tokens=8, name="lm-drain",
+    ), registry=MetricsRegistry()).start()
+    handles = [replica.submit([3, 1, 4], max_new_tokens=6)
+               for _ in range(3)]
+    replica.stop(drain=True)  # every admitted stream resolves first
+    for h in handles:
+        r = h.result(timeout=1)
+        assert len(r["tokens"]) == 6
+
+
+# -- router: affinity + zero-drop migration ------------------------------------
+
+
+def test_router_affinity_prefers_kv_headroom(lm_replica_factory):
+    small = lm_replica_factory(kv_blocks=4, kv_block_tokens=8, name="lm-small")
+    big = lm_replica_factory(kv_blocks=64, kv_block_tokens=8, name="lm-big")
+    router = Router([small, big])
+    # burn most of the small pool so headroom clearly differs
+    blocker = small.submit([1, 2], max_new_tokens=20)
+    results = [router.generate([5, 9], max_new_tokens=3) for _ in range(3)]
+    assert all(len(r["tokens"]) == 3 for r in results)
+    blocker.result(timeout=120)
+    assert big.status()["completed"] == 3  # affinity routed to headroom
+    assert small.status()["completed"] == 1
+
+
+def test_router_migrates_streams_on_remove_with_zero_drops(
+        lm_replica_factory):
+    rep_a = lm_replica_factory(name="lm-mig-a", seq_buckets=(16, 64),
+                               kv_blocks=64)
+    rep_b = lm_replica_factory(name="lm-mig-b", seq_buckets=(16, 64),
+                               kv_blocks=64)
+    router = Router([rep_a, rep_b])
+    rng = np.random.default_rng(1)
+    # 40-token budgets: no stream can finish in the gap before the
+    # rescale below, so the remove genuinely evicts mid-decode
+    handles = [router.generate_async(rng.integers(1, 60, size=4),
+                                     max_new_tokens=40)
+               for _ in range(6)]
+    removed = router.remove(rep_a.config.name)
+    removed.stop()
+    results = [h.result(timeout=120) for h in handles]
+    stats = router.stats()
+    assert stats["dropped_streams"] == 0
+    # exact generated-token accounting across the migration: prefix
+    # stitched to the resumed remainder, nothing dropped or doubled
+    assert all(len(r["tokens"]) == 40 for r in results)
+    assert stats["migrations"] >= 1  # the rescale actually moved streams
+    assert all(r["finish_reason"] == "length" for r in results)
+
+
+def test_router_migrated_stream_matches_unmigrated_tokens(
+        lm_replica_factory):
+    """The zero-drop contract is not just counts: a migrated stream's
+    stitched token list must be EXACTLY what an unmigrated run yields
+    (greedy decode is deterministic — re-prefilling prompt+generated on
+    the target replica continues the same sequence)."""
+    rep_a = lm_replica_factory(name="lm-ex-a")
+    rep_b = lm_replica_factory(name="lm-ex-b")
+    prompt = np.asarray([7, 3, 29], dtype=np.int32)
+    reference = rep_b.generate(prompt, max_new_tokens=12)["tokens"]
+
+    router = Router([rep_a])  # only rep_a takes the stream...
+    handle = router.generate_async(prompt, max_new_tokens=12)
+    router.add(rep_b)  # ...then the pool rescales under it
+    router.remove(rep_a.config.name)
+    result = handle.result(timeout=120)
+    assert result["tokens"] == reference
+    assert result["migrations"] >= 1
+
+
+def test_router_raises_when_pool_has_no_lm_replica():
+    router = Router()
+    with pytest.raises(NoReplicaError):
+        router.generate_async([1, 2, 3], max_new_tokens=2)
+    with pytest.raises(NoReplicaError):
+        router.submit({"x": np.zeros(13, np.float32)})
+
+
+# -- config validation ---------------------------------------------------------
+
+
+def test_lm_config_validates_ladders_and_pool(lm_artifact):
+    with pytest.raises(ValueError):
+        LMServingConfig(model_dir=lm_artifact, seq_buckets=(32, 16))
+    with pytest.raises(ValueError):
+        LMServingConfig(model_dir=lm_artifact, kv_blocks=1,
+                        kv_block_tokens=1, seq_buckets=(16,))
+    with pytest.raises(ValueError):
+        LMServingConfig(model_dir=lm_artifact, default_max_new_tokens=0)
+    # seq bucket beyond the model's trained positions fails at start
+    replica = LMServingReplica(LMServingConfig(
+        model_dir=lm_artifact, seq_buckets=(16, 128), kv_blocks=32,
+        kv_block_tokens=8, name="lm-bad-seq",
+    ))
+    with pytest.raises(ValueError, match="seq_len"):
+        replica.start()
